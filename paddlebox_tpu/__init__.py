@@ -1,0 +1,18 @@
+"""paddlebox_tpu — a TPU-native sparse-CTR training framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of PaddleBox
+(Baidu's GPU-box sparse parameter-server trainer embedded in the PaddlePaddle
+fork at mark914/PaddleBox): trillion-parameter embedding tables streamed
+through a tiered parameter server (TPU HBM working set -> host DRAM -> SSD),
+pass/day-scoped datasets with inter-host shuffle, fused CTR kernels, streaming
+AUC metrics, and DP/TP/PP/sharding/MoE/CP parallelism over a jax device mesh.
+
+Structural parity map: see SURVEY.md at the repo root.  Reference citations in
+docstrings point into /root/reference (mark914/PaddleBox).
+"""
+
+from paddlebox_tpu.version import __version__  # noqa: F401
+from paddlebox_tpu import flags  # noqa: F401
+
+set_flags = flags.set_flags
+get_flags = flags.get_flags

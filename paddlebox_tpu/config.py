@@ -1,0 +1,158 @@
+"""Structured configs.
+
+TPU-native replacement for the reference's three config layers (SURVEY.md §5):
+protobuf descs TrainerDesc (trainer_desc.proto:21), DataFeedDesc
+(data_feed.proto:17-43) and DistributedStrategy
+(fleet/base/distributed_strategy.py:110) become plain dataclasses; gflags
+become paddlebox_tpu.flags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotConfig:
+    """One input slot (≙ data_feed.proto Slot: name/type/is_used/is_dense).
+
+    ``capacity`` is the static per-instance feasign capacity used to pad
+    variable-length slots for XLA (the reference carries true var-len LoD;
+    under jit we need fixed shapes — SURVEY.md §7 hard part (5)).
+    """
+
+    name: str
+    slot_id: int = 0
+    dtype: str = "uint64"  # "uint64" (sparse feasigns) or "float" (dense)
+    is_dense: bool = False
+    dim: int = 1           # values per instance for dense slots
+    capacity: int = 1      # max feasigns per instance for sparse slots
+
+
+@dataclasses.dataclass(frozen=True)
+class DataFeedConfig:
+    """≙ DataFeedDesc (data_feed.proto:17-43)."""
+
+    slots: Tuple[SlotConfig, ...]
+    batch_size: int = 512
+    pipe_command: str = ""          # shell preprocessor (≙ pipe_command_)
+    parser: str = "multi_slot"      # "multi_slot" | "slot_feasign"
+    rand_seed: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "slots", tuple(self.slots))
+
+    @property
+    def sparse_slots(self) -> List[SlotConfig]:
+        return [s for s in self.slots if not s.is_dense]
+
+    @property
+    def dense_slots(self) -> List[SlotConfig]:
+        return [s for s in self.slots if s.is_dense]
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseSGDConfig:
+    """Per-feature optimizer hyper-parameters.
+
+    Field-for-field parity with OptimizerConfig
+    (heter_ps/optimizer_conf.h:22-45); defaults match the reference.
+    """
+
+    optimizer: str = "adagrad"   # adagrad | adam | shared_adam | naive
+    nonclk_coeff: float = 0.1
+    clk_coeff: float = 1.0
+    min_bound: float = -10.0
+    max_bound: float = 10.0
+    learning_rate: float = 0.05
+    initial_g2sum: float = 3.0
+    initial_range: float = 1e-4
+    beta1_decay_rate: float = 0.9
+    beta2_decay_rate: float = 0.999
+    ada_epsilon: float = 1e-8
+    mf_create_thresholds: float = 10.0
+    mf_learning_rate: float = 0.05
+    mf_initial_g2sum: float = 3.0
+    mf_initial_range: float = 1e-4
+    mf_min_bound: float = -10.0
+    mf_max_bound: float = 10.0
+    feature_learning_rate: float = 0.05
+    nodeid_slot: int = 9008
+
+
+@dataclasses.dataclass(frozen=True)
+class AccessorConfig:
+    """Feature lifecycle policy (≙ CtrCommonAccessor / ctr_accessor.h):
+    show/click time-decay each pass-day, delete/shrink thresholds, save
+    thresholds for base/delta dumps."""
+
+    show_click_decay_rate: float = 0.98
+    delete_threshold: float = 0.8
+    delete_after_unseen_days: float = 30.0
+    base_threshold: float = 1.5      # save_base keeps score >= this
+    delta_threshold: float = 0.25    # save_delta keeps |delta_score| >= this
+    delta_keep_days: float = 16.0
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingTableConfig:
+    """One logical sparse table (≙ DistributedStrategy sparse_table_configs,
+    distributed_strategy.py:534-640, + CommonFeatureValue layout
+    feature_value.h:44-57)."""
+
+    name: str = "embedding"
+    embedding_dim: int = 8           # mf_dim (embedx width, excl. show/clk/lr-w)
+    sgd: SparseSGDConfig = dataclasses.field(default_factory=SparseSGDConfig)
+    accessor: AccessorConfig = dataclasses.field(default_factory=AccessorConfig)
+    shard_num: int = 16              # host-table shards (≙ memory_sparse_table.h:46)
+    quant_bits: int = 0              # 0 = no embedding quantization
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainerConfig:
+    """≙ TrainerDesc + BoxPSWorkerParameter (trainer_desc.proto:21,121-129)."""
+
+    thread_num: int = 1
+    dense_sync_mode: str = "allreduce"   # allreduce | async_table | sharded
+    sync_weight_step: int = 1            # ≙ sync_weight_step
+    dump_fields: Tuple[str, ...] = ()
+    dump_path: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Hybrid-parallel topology degrees (≙ HybridCommunicateGroup,
+    fleet/base/topology.py:134-144 [dp, sharding, pp, mp] — extended with the
+    TPU-first sp/ep axes the reference lacks, SURVEY.md §2.7)."""
+
+    dp: int = 1
+    sharding: int = 1
+    pp: int = 1
+    mp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def degrees(self):
+        return {"dp": self.dp, "sharding": self.sharding, "pp": self.pp,
+                "mp": self.mp, "sp": self.sp, "ep": self.ep}
+
+    @property
+    def world_size(self) -> int:
+        n = 1
+        for v in self.degrees().values():
+            n *= v
+        return n
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedStrategy:
+    """≙ fleet.DistributedStrategy (distributed_strategy.py:110)."""
+
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    amp: bool = False
+    amp_dtype: str = "bfloat16"
+    gradient_merge_steps: int = 1
+    recompute: bool = False
+    table: EmbeddingTableConfig = dataclasses.field(
+        default_factory=EmbeddingTableConfig)

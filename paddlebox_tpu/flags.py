@@ -1,0 +1,87 @@
+"""Global flag registry.
+
+TPU-native equivalent of the reference's gflags layer
+(paddle/fluid/platform/flags.cc — e.g. the PaddleBox block at flags.cc:946-975:
+enable_pullpush_dedup_keys, padbox_record_pool_max_size,
+padbox_dataset_shuffle_thread_num, ...).  Flags are plain Python values with
+defaults, overridable by environment variables ``FLAGS_<name>`` at first read
+and programmatically via :func:`set_flags` (mirroring ``paddle.set_flags``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+_LOCK = threading.Lock()
+_DEFS: Dict[str, Any] = {}
+_VALUES: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default: Any, help_str: str = "") -> None:
+    with _LOCK:
+        if name in _DEFS:
+            return
+        _DEFS[name] = (default, help_str)
+        env = os.environ.get("FLAGS_" + name)
+        if env is not None:
+            _VALUES[name] = _coerce(env, default)
+        else:
+            _VALUES[name] = default
+
+
+def _coerce(text: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return text.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(text)
+    if isinstance(default, float):
+        return float(text)
+    return text
+
+
+def get_flags(name: str) -> Any:
+    with _LOCK:
+        if name not in _VALUES:
+            raise KeyError(f"undefined flag: {name}")
+        return _VALUES[name]
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    with _LOCK:
+        for k, v in flags.items():
+            if k not in _DEFS:
+                raise KeyError(f"undefined flag: {k}")
+            _VALUES[k] = v
+
+
+def all_flags() -> Dict[str, Any]:
+    with _LOCK:
+        return dict(_VALUES)
+
+
+# ---------------------------------------------------------------------------
+# Core flag set (parity with the PaddleBox block, flags.cc:946-975, plus
+# TPU-specific knobs).
+# ---------------------------------------------------------------------------
+define_flag("enable_pullpush_dedup_keys", True,
+            "dedup minibatch keys before pull/push (flags.cc:946)")
+define_flag("enable_pull_box_padding_zero", True,
+            "key 0 pulls a zero embedding (flags.cc:950)")
+define_flag("record_pool_max_size", 2_000_000,
+            "SlotRecord arena cap (flags.cc:956 padbox_record_pool_max_size)")
+define_flag("dataset_shuffle_thread_num", 20,
+            "global-shuffle sender threads (flags.cc:966)")
+define_flag("dataset_merge_thread_num", 20,
+            "shuffle-receiver merge threads (flags.cc:968)")
+define_flag("auc_runner_mode", False,
+            "enable AucRunner slot-replacement eval (flags.cc:972)")
+define_flag("check_nan_inf", False,
+            "per-batch NaN/Inf scan of model outputs (boxps_worker.cc:1326)")
+define_flag("feed_pass_thread_num", 8,
+            "threads used to extract pass feasigns (box_wrapper.h:873 uses 30)")
+define_flag("pass_build_chunk", 500_000,
+            "host->device pass-build chunk size (ps_gpu_wrapper.cc:757)")
+define_flag("tpu_batch_key_capacity", 0,
+            "static per-batch key capacity; 0 = derive from data feed config")

@@ -1,0 +1,1 @@
+from paddlebox_tpu.parallel.topology import HybridTopology  # noqa: F401

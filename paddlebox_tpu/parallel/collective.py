@@ -1,0 +1,71 @@
+"""Collective primitives.
+
+≙ distributed/collective/ProcessGroup.h:53-190 (AllReduce/Broadcast/AllGather/
+AllToAll/ReduceScatter/Send/Recv) — but as jax named-axis collectives usable
+inside ``shard_map``/``pjit``-traced code, riding ICI instead of NCCL.  The
+reference's explicit P2P "walk paths" (heter_comm.h:303) map to
+``lax.ppermute``; its MoE global_scatter/global_gather map to
+``lax.all_to_all``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+Axis = Union[str, Sequence[str]]
+
+
+def all_reduce(x, axis: Axis, op: str = "sum"):
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    raise ValueError(f"unsupported all_reduce op: {op}")
+
+
+def all_gather(x, axis: Axis, *, concat_dim: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis, axis=concat_dim, tiled=tiled)
+
+
+def all_to_all(x, axis: Axis, *, split_dim: int = 0, concat_dim: int = 0,
+               tiled: bool = True):
+    return lax.all_to_all(x, axis, split_axis=split_dim,
+                          concat_axis=concat_dim, tiled=tiled)
+
+
+def reduce_scatter(x, axis: Axis, *, scatter_dim: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+
+
+def ppermute(x, axis: Axis, perm):
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: Axis):
+    return lax.axis_index(axis)
+
+
+def shift_right(x, axis: str, axis_size: int):
+    """Ring shift: device i sends to i+1 (mod n). Building block of ring
+    attention / pipelined CP (no reference equivalent — SURVEY.md §2.7)."""
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    return lax.ppermute(x, axis, perm)
+
+
+def shard_mapped(mesh, in_specs, out_specs, check_vma: bool = False):
+    """Decorator shorthand for shard_map over the framework mesh."""
+    def wrap(fn):
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=check_vma)
+    return wrap

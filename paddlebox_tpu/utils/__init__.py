@@ -1,0 +1,3 @@
+from paddlebox_tpu.utils.channel import Channel  # noqa: F401
+from paddlebox_tpu.utils.timer import Timer, TimerRegistry  # noqa: F401
+from paddlebox_tpu.utils.monitor import StatRegistry, stat_add, stat_get  # noqa: F401

@@ -1,0 +1,51 @@
+"""Process-wide stat gauges (≙ platform/monitor.h:80 StatRegistry and the
+STAT_INT_ADD macros at monitor.h:137)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class StatRegistry:
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._stats: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "StatRegistry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def add(self, name: str, value: float) -> None:
+        with self._lock:
+            self._stats[name] = self._stats.get(name, 0.0) + value
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._stats[name] = value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._stats.get(name, 0.0)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._stats)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+def stat_add(name: str, value: float = 1.0) -> None:
+    StatRegistry.instance().add(name, value)
+
+
+def stat_get(name: str) -> float:
+    return StatRegistry.instance().get(name)

@@ -1,0 +1,18 @@
+"""Test env: force a virtual 8-device CPU platform so multi-chip sharding
+logic is exercised without TPU hardware (SURVEY.md §4 'Implication')."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The image's sitecustomize imports jax and pins the 'axon' TPU platform
+# before conftest runs, so the env var alone is too late — override via
+# jax.config (safe: no backend has been initialized yet).
+import jax
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,103 @@
+import threading
+import time
+
+import pytest
+
+from paddlebox_tpu.utils.channel import Channel, ChannelClosed
+from paddlebox_tpu.utils.timer import Timer, TimerRegistry
+from paddlebox_tpu.utils.monitor import StatRegistry, stat_add, stat_get
+from paddlebox_tpu import flags
+
+
+def test_channel_fifo_and_eof():
+    ch = Channel(capacity=4)
+    ch.put(1)
+    ch.put(2)
+    ch.close()
+    assert ch.get() == 1
+    assert ch.get() == 2
+    with pytest.raises(ChannelClosed):
+        ch.get()
+
+
+def test_channel_mpmc():
+    ch = Channel(capacity=8)
+    out = []
+    lock = threading.Lock()
+
+    def producer(base):
+        for i in range(100):
+            ch.put(base + i)
+
+    def consumer():
+        for item in ch:
+            with lock:
+                out.append(item)
+
+    producers = [threading.Thread(target=producer, args=(k * 1000,))
+                 for k in range(3)]
+    consumers = [threading.Thread(target=consumer) for _ in range(2)]
+    for t in producers + consumers:
+        t.start()
+    for t in producers:
+        t.join()
+    ch.close()
+    for t in consumers:
+        t.join()
+    assert sorted(out) == sorted(k * 1000 + i for k in range(3)
+                                 for i in range(100))
+
+
+def test_channel_get_many():
+    ch = Channel()
+    ch.put_many(range(5))
+    assert ch.get_many(3) == [0, 1, 2]
+    ch.close()
+    assert ch.get_many(10) == [3, 4]
+    assert ch.get_many(10) == []
+
+
+def test_channel_blocking_put_respects_capacity():
+    ch = Channel(capacity=1)
+    ch.put(0)
+    done = []
+
+    def blocked_put():
+        ch.put(1)
+        done.append(True)
+
+    t = threading.Thread(target=blocked_put)
+    t.start()
+    time.sleep(0.05)
+    assert not done
+    assert ch.get() == 0
+    t.join(timeout=2)
+    assert done
+
+
+def test_timer():
+    t = Timer()
+    with t:
+        time.sleep(0.01)
+    assert t.elapsed_sec() >= 0.01
+    assert t.count() == 1
+    reg = TimerRegistry()
+    with reg("pull"):
+        pass
+    assert "pull=" in reg.report()
+
+
+def test_monitor():
+    StatRegistry.instance().reset()
+    stat_add("total_feasign_num_in_mem", 5)
+    stat_add("total_feasign_num_in_mem", 7)
+    assert stat_get("total_feasign_num_in_mem") == 12
+
+
+def test_flags_roundtrip():
+    assert flags.get_flags("enable_pullpush_dedup_keys") in (True, False)
+    flags.set_flags({"check_nan_inf": True})
+    assert flags.get_flags("check_nan_inf") is True
+    flags.set_flags({"check_nan_inf": False})
+    with pytest.raises(KeyError):
+        flags.get_flags("no_such_flag")
