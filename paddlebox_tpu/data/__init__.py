@@ -1,0 +1,2 @@
+from paddlebox_tpu.data.slot_record import SlotRecordBlock  # noqa: F401
+from paddlebox_tpu.data.dataset import SlotDataset  # noqa: F401

@@ -1,0 +1,224 @@
+"""Pass-scoped in-memory dataset.
+
+≙ Dataset/DatasetImpl/SlotRecordDataset/PadBoxSlotDataset
+(data_set.h:58-568): a pass (typically ~10 min of logs) is loaded into host
+memory by reader threads, optionally shuffled locally and across hosts, then
+iterated as device batches while the next pass preloads
+(≙ PreLoadIntoMemory data_set.cc:2219, BoxHelper overlap box_wrapper.h:1141).
+
+The inter-host global shuffle (≙ PaddleShuffler MPI transport,
+data_set.cc:2440-2648) goes through a pluggable ``ShuffleTransport``; the
+in-process LoopbackTransport covers single-host and tests, a gRPC/proxy
+transport covers multi-host (paddlebox_tpu/data/shuffle_transport.py).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.config import DataFeedConfig
+from paddlebox_tpu.data.data_feed import DataFeed
+from paddlebox_tpu.data.slot_record import SlotRecordBlock
+from paddlebox_tpu.utils.channel import Channel
+from paddlebox_tpu.utils.monitor import stat_add
+from paddlebox_tpu import flags
+
+
+class ShuffleTransport:
+    """Cross-host record exchange (≙ boxps::PaddleShuffler)."""
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def world_size(self) -> int:
+        return 1
+
+    def send(self, dst: int, block: SlotRecordBlock) -> None:
+        raise NotImplementedError
+
+    def drain(self) -> List[SlotRecordBlock]:
+        """Blocks sent to this rank by peers (called after barrier)."""
+        raise NotImplementedError
+
+    def barrier(self) -> None:
+        pass
+
+
+class LoopbackTransport(ShuffleTransport):
+    """Single-process world; optionally emulates N ranks for tests."""
+
+    def __init__(self, world_size: int = 1, rank: int = 0, mailboxes=None,
+                 barrier: Optional[threading.Barrier] = None):
+        self._world = world_size
+        self._rank = rank
+        self._mailboxes = mailboxes if mailboxes is not None else \
+            [Channel() for _ in range(world_size)]
+        self._barrier = barrier
+
+    @classmethod
+    def make_world(cls, world_size: int) -> List["LoopbackTransport"]:
+        boxes = [Channel() for _ in range(world_size)]
+        bar = threading.Barrier(world_size)
+        return [cls(world_size, r, boxes, bar) for r in range(world_size)]
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world
+
+    def send(self, dst: int, block: SlotRecordBlock) -> None:
+        self._mailboxes[dst].put(block)
+
+    def drain(self) -> List[SlotRecordBlock]:
+        out = []
+        while self._mailboxes[self._rank].size():
+            out.append(self._mailboxes[self._rank].get())
+        return out
+
+    def barrier(self) -> None:
+        if self._barrier is not None:
+            self._barrier.wait()
+
+
+class SlotDataset:
+    """≙ PadBoxSlotDataset (data_set.h:438)."""
+
+    def __init__(self, feed_config: DataFeedConfig,
+                 parse_ins_id: bool = False, parse_logkey: bool = False,
+                 read_threads: int = 4,
+                 transport: Optional[ShuffleTransport] = None):
+        self.feed_config = feed_config
+        self.parse_ins_id = parse_ins_id
+        self.parse_logkey = parse_logkey
+        self.read_threads = read_threads
+        self.transport = transport or LoopbackTransport()
+        self.filelist: List[str] = []
+        self._blocks: List[SlotRecordBlock] = []
+        self._preload_future = None
+        self._lock = threading.Lock()
+        self._rng = np.random.default_rng(feed_config.rand_seed or None)
+        self._key_consumers: List[Callable[[np.ndarray], None]] = []
+
+    # -- file list -----------------------------------------------------------
+    def set_filelist(self, filelist: Sequence[str]) -> None:
+        self.filelist = list(filelist)
+
+    # -- pass feasign tap (≙ MergeInsKeys → PSAgent::AddKey data_set.cc:2293)
+    def register_key_consumer(self, fn: Callable[[np.ndarray], None]) -> None:
+        self._key_consumers.append(fn)
+
+    # -- load ----------------------------------------------------------------
+    def _read_all(self) -> List[SlotRecordBlock]:
+        files = list(self.filelist)
+        blocks: List[SlotRecordBlock] = []
+        lock = threading.Lock()
+
+        def read_one(path: str) -> None:
+            feed = DataFeed(self.feed_config, self.parse_ins_id,
+                            self.parse_logkey)
+            for block in feed.read_file(path):
+                for consumer in self._key_consumers:
+                    consumer(block.all_keys())
+                with lock:
+                    blocks.append(block)
+
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(1, self.read_threads)) as pool:
+            list(pool.map(read_one, files))
+        return blocks
+
+    def load_into_memory(self) -> None:
+        self._blocks = self._read_all()
+        stat_add("stat_dataset_instances", self.instance_num())
+
+    def preload_into_memory(self) -> None:
+        """Overlap next-pass read with current training
+        (≙ PreLoadIntoMemory box_wrapper.h:1141)."""
+        ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        self._preload_future = ex.submit(self._read_all)
+        ex.shutdown(wait=False)
+
+    def wait_preload_done(self) -> None:
+        if self._preload_future is not None:
+            self._blocks = self._preload_future.result()
+            self._preload_future = None
+
+    def release_memory(self) -> None:
+        self._blocks = []
+
+    # -- shuffle -------------------------------------------------------------
+    def local_shuffle(self) -> None:
+        block = SlotRecordBlock.concat(self._blocks)
+        if block.n:
+            block = block.permute(self._rng.permutation(block.n))
+        self._blocks = [block] if block.n else []
+
+    def global_shuffle(self, by_ins_id: bool = False) -> None:
+        """Redistribute records across hosts: hash(ins_id) or random % world
+        (≙ ShuffleData data_set.cc:2440 + ReceiveSuffleData :2548)."""
+        world = self.transport.world_size
+        if world <= 1:
+            return self.local_shuffle()
+        merged = SlotRecordBlock.concat(self._blocks)
+        if merged.n:
+            if by_ins_id and merged.ins_ids is not None:
+                dest = np.array([hash(i) % world for i in merged.ins_ids],
+                                dtype=np.int64)
+            else:
+                dest = self._rng.integers(0, world, size=merged.n)
+            keep = []
+            for r in range(world):
+                part = merged.select(np.nonzero(dest == r)[0])
+                if r == self.transport.rank:
+                    keep.append(part)
+                elif part.n:
+                    self.transport.send(r, part)
+        else:
+            keep = []
+        self.transport.barrier()
+        received = self.transport.drain()
+        block = SlotRecordBlock.concat(keep + received)
+        if block.n:
+            block = block.permute(self._rng.permutation(block.n))
+        self._blocks = [block] if block.n else []
+
+    # -- PV / ins merge (AucRunner) -----------------------------------------
+    def preprocess_instance(self) -> None:
+        """Group records by search_id so a page-view trains as a unit
+        (≙ PreprocessInstance data_set.cc:2648).  Records are stably sorted
+        by search_id; un-keyed records keep relative order at the end."""
+        merged = SlotRecordBlock.concat(self._blocks)
+        if merged.n == 0 or merged.search_ids is None:
+            return
+        order = np.argsort(merged.search_ids, kind="stable")
+        self._blocks = [merged.permute(order)]
+
+    # -- iteration -----------------------------------------------------------
+    def instance_num(self) -> int:
+        return sum(b.n for b in self._blocks)
+
+    def feasign_num(self) -> int:
+        return sum(b.feasign_count for b in self._blocks)
+
+    def get_blocks(self) -> List[SlotRecordBlock]:
+        return self._blocks
+
+    def batches(self, batch_size: int, drop_last: bool = False
+                ) -> Iterator[SlotRecordBlock]:
+        """Yield fixed-size record batches; the tail short batch is yielded
+        unless drop_last (the device step pads it to capacity anyway)."""
+        merged = SlotRecordBlock.concat(self._blocks)
+        for start in range(0, merged.n, batch_size):
+            stop = min(start + batch_size, merged.n)
+            if stop - start < batch_size and drop_last:
+                return
+            yield merged.slice(start, stop)

@@ -1,0 +1,171 @@
+import os
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import DataFeedConfig, SlotConfig
+from paddlebox_tpu.data.data_feed import DataFeed, SlotParser, parse_logkey
+from paddlebox_tpu.data.batch_pack import BatchPacker
+from paddlebox_tpu.data.dataset import SlotDataset, LoopbackTransport
+from paddlebox_tpu.data.slot_record import SlotRecordBlock
+
+
+def make_config():
+    return DataFeedConfig(
+        slots=(
+            SlotConfig("label", dtype="float", is_dense=True, dim=1),
+            SlotConfig("dense0", dtype="float", is_dense=True, dim=3),
+            SlotConfig("slot_a", slot_id=1, capacity=3),
+            SlotConfig("slot_b", slot_id=2, capacity=2),
+        ),
+        batch_size=4,
+    )
+
+
+def write_slot_file(path, rows):
+    """rows: list of (label, dense3, a_keys, b_keys)"""
+    with open(path, "w") as f:
+        for label, dense, a, b in rows:
+            parts = [f"1 {label}", f"3 " + " ".join(str(d) for d in dense),
+                     f"{len(a)} " + " ".join(str(k) for k in a),
+                     f"{len(b)} " + " ".join(str(k) for k in b)]
+            f.write(" ".join(parts) + "\n")
+
+
+ROWS = [
+    (1, [0.1, 0.2, 0.3], [11, 12], [21]),
+    (0, [0.4, 0.5, 0.6], [13], [22, 23]),
+    (1, [0.7, 0.8, 0.9], [14, 15, 16, 17], [24]),  # slot_a overflows cap 3
+    (0, [1.0, 1.1, 1.2], [18], [25]),
+    (1, [1.3, 1.4, 1.5], [19], [26]),
+]
+
+
+@pytest.fixture
+def slot_file(tmp_path):
+    p = tmp_path / "part-00000"
+    write_slot_file(p, ROWS)
+    return str(p)
+
+
+def test_parse_block(slot_file):
+    cfg = make_config()
+    feed = DataFeed(cfg, use_native=False)
+    blocks = list(feed.read_file(slot_file))
+    block = SlotRecordBlock.concat(blocks)
+    assert block.n == 5
+    vals, off = block.uint64_slots["slot_a"]
+    assert list(off) == [0, 2, 3, 7, 8, 9]
+    assert list(vals) == [11, 12, 13, 14, 15, 16, 17, 18, 19]
+    lv, lo = block.float_slots["label"]
+    np.testing.assert_allclose(lv, [1, 0, 1, 0, 1])
+    assert block.feasign_count == 15  # 9 in slot_a + 6 in slot_b
+
+
+def test_parse_ins_id_and_logkey():
+    cfg = DataFeedConfig(slots=(SlotConfig("s", capacity=1),))
+    parser = SlotParser(cfg, parse_ins_id=True, parse_logkey=True)
+    # ins_id then logkey: search_id=0xabc, cmatch=0x01, rank=0x02
+    block = parser.parse_block(["1 insX 1 abc0102 1 42"])
+    assert block.ins_ids == ["insX"]
+    assert int(block.search_ids[0]) == 0xabc
+    assert int(block.cmatch[0]) == 1
+    assert int(block.rank[0]) == 2
+    assert parse_logkey("abc0102") == (0xabc, 1, 2)
+
+
+def test_select_and_concat():
+    cfg = make_config()
+    parser = SlotParser(cfg)
+    lines = []
+    for label, dense, a, b in ROWS:
+        lines.append(" ".join([
+            f"1 {label}", "3 " + " ".join(map(str, dense)),
+            f"{len(a)} " + " ".join(map(str, a)),
+            f"{len(b)} " + " ".join(map(str, b))]))
+    block = parser.parse_block(lines)
+    sel = block.select(np.array([2, 0]))
+    vals, off = sel.uint64_slots["slot_a"]
+    assert list(vals) == [14, 15, 16, 17, 11, 12]
+    assert list(off) == [0, 4, 6]
+    back = SlotRecordBlock.concat([sel, block.select(np.array([1]))])
+    assert back.n == 3
+
+
+def test_dataset_load_shuffle_batches(slot_file, tmp_path):
+    cfg = make_config()
+    p2 = tmp_path / "part-00001"
+    write_slot_file(p2, ROWS[:2])
+    ds = SlotDataset(cfg, read_threads=2)
+    ds.set_filelist([slot_file, str(p2)])
+    seen_keys = []
+    ds.register_key_consumer(lambda ks: seen_keys.append(ks.copy()))
+    ds.load_into_memory()
+    assert ds.instance_num() == 7
+    total_keys = np.concatenate(seen_keys)
+    assert len(total_keys) == 15 + 6  # feasigns from both files
+    ds.local_shuffle()
+    assert ds.instance_num() == 7
+    batches = list(ds.batches(4))
+    assert [b.n for b in batches] == [4, 3]
+    batches = list(ds.batches(4, drop_last=True))
+    assert [b.n for b in batches] == [4]
+
+
+def test_global_shuffle_loopback():
+    cfg = DataFeedConfig(slots=(SlotConfig("s", capacity=2),))
+    parser = SlotParser(cfg)
+    world = LoopbackTransport.make_world(2)
+    datasets = []
+    for r in range(2):
+        ds = SlotDataset(cfg, transport=world[r])
+        lines = [f"1 {100 * r + i}" for i in range(10)]
+        ds._blocks = [parser.parse_block(lines)]
+        datasets.append(ds)
+    import threading
+    threads = [threading.Thread(target=ds.global_shuffle) for ds in datasets]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    all_keys = []
+    for ds in datasets:
+        for b in ds.get_blocks():
+            all_keys.extend(b.uint64_slots["s"][0].tolist())
+    assert sorted(all_keys) == sorted(
+        [100 * r + i for r in range(2) for i in range(10)])
+    assert datasets[0].instance_num() + datasets[1].instance_num() == 20
+
+
+def test_batch_pack(slot_file):
+    cfg = make_config()
+    feed = DataFeed(cfg, use_native=False)
+    block = SlotRecordBlock.concat(list(feed.read_file(slot_file)))
+    packer = BatchPacker(cfg, batch_size=8, label_slot="label")
+    key_map = {0: 0, 11: 1, 12: 2, 13: 3, 14: 4, 15: 5, 16: 6, 17: 7,
+               18: 8, 19: 9, 21: 10, 22: 11, 23: 12, 24: 13, 25: 14, 26: 15}
+    mapper = np.vectorize(lambda k: key_map.get(int(k), 0))
+    batch = packer.pack(block, key_mapper=lambda ks: mapper(ks))
+    S, B, L = batch.indices.shape
+    assert (S, B, L) == (2, 8, 3)
+    assert batch.num_real == 5
+    assert batch.valid.sum() == 5
+    # slot_a row 2 overflows capacity: clipped to 3
+    assert batch.lengths[0, 2] == 3
+    assert list(batch.indices[0, 2]) == [4, 5, 6]
+    # slot_b row 1: two keys then padding 0
+    assert list(batch.indices[1, 1]) == [11, 12, 0]
+    np.testing.assert_allclose(batch.labels[:5], [1, 0, 1, 0, 1])
+    np.testing.assert_allclose(batch.dense[0], [0.1, 0.2, 0.3])
+    assert batch.dense.shape == (8, 3)
+
+
+def test_preload(slot_file):
+    cfg = make_config()
+    ds = SlotDataset(cfg)
+    ds.set_filelist([slot_file])
+    ds.preload_into_memory()
+    ds.wait_preload_done()
+    assert ds.instance_num() == 5
+    ds.release_memory()
+    assert ds.instance_num() == 0
