@@ -1,0 +1,1 @@
+from paddlebox_tpu.metrics.auc import AucCalculator, MetricGroup  # noqa: F401

@@ -1,0 +1,196 @@
+"""Streaming AUC / calibration metrics.
+
+≙ BasicAucCalculator (fleet/metrics.h:46, metrics.cc:284-410) and the named
+multi-metric registry with join/update phase filtering (box_wrapper.h:769-792,
+MetricMsg hierarchy metrics.h:204+).
+
+TPU-first split: bucket accumulation is a jit-able pure function
+(scatter-add into pos/neg tables — runs on device inside the train step, the
+equivalent of `mode_collect_in_gpu`, box_wrapper.h:787), while the final
+compute() is a host-side reduction over the 1M-bucket tables.  Cross-host
+aggregation is a jax psum over the data axis instead of the reference's
+MPI/gloo allreduce (metrics.cc:288-307).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+TABLE_SIZE = 1_000_000  # ≙ box_wrapper.h:786
+K_RELATIVE_ERROR_BOUND = 0.05  # ≙ metrics.h:193
+K_MAX_SPAN = 0.01              # ≙ metrics.h:194
+
+
+def make_auc_state(table_size: int = TABLE_SIZE) -> Dict[str, jnp.ndarray]:
+    """Device-side accumulator pytree: pos/neg bucket tables + scalar sums
+    [abserr, sqrerr, pred_sum, label_sum, total]."""
+    return {
+        "pos": jnp.zeros((table_size,), jnp.float32),
+        "neg": jnp.zeros((table_size,), jnp.float32),
+        "scalars": jnp.zeros((5,), jnp.float32),
+    }
+
+
+def accumulate_auc(state: Dict[str, jnp.ndarray], pred: jnp.ndarray,
+                   label: jnp.ndarray, mask: Optional[jnp.ndarray] = None
+                   ) -> Dict[str, jnp.ndarray]:
+    """Pure jit-able bucket accumulation (≙ add_unlock_data metrics.cc:84-105
+    vectorized).  pred/label: [B]; mask False drops padded records
+    (≙ add_mask_data metrics.cc:164)."""
+    table_size = state["pos"].shape[0]
+    pred = jnp.clip(pred.astype(jnp.float32), 0.0, 1.0)
+    label = label.astype(jnp.float32)
+    if mask is None:
+        w = jnp.ones_like(pred)
+    else:
+        w = mask.astype(jnp.float32)
+    bucket = jnp.clip((pred * table_size).astype(jnp.int32), 0, table_size - 1)
+    pos = state["pos"].at[bucket].add(w * label)
+    neg = state["neg"].at[bucket].add(w * (1.0 - label))
+    err = pred - label
+    scalars = state["scalars"] + jnp.stack([
+        jnp.sum(w * jnp.abs(err)),
+        jnp.sum(w * err * err),
+        jnp.sum(w * pred),
+        jnp.sum(w * label),
+        jnp.sum(w),
+    ])
+    return {"pos": pos, "neg": neg, "scalars": scalars}
+
+
+class AucCalculator:
+    """Host wrapper with the reference's result surface
+    (auc/bucket_error/mae/rmse/actual_ctr/predicted_ctr, metrics.h:108-121)."""
+
+    def __init__(self, table_size: int = TABLE_SIZE):
+        self.table_size = table_size
+        self.reset()
+
+    def reset(self) -> None:
+        self._pos = np.zeros((self.table_size,), np.float64)
+        self._neg = np.zeros((self.table_size,), np.float64)
+        self._scalars = np.zeros((5,), np.float64)
+
+    # -- host-side add (small batches / tests) ------------------------------
+    def add_data(self, pred, label, mask=None) -> None:
+        pred = np.clip(np.asarray(pred, np.float64), 0.0, 1.0)
+        label = np.asarray(label, np.float64)
+        w = np.ones_like(pred) if mask is None else \
+            np.asarray(mask, np.float64)
+        bucket = np.clip((pred * self.table_size).astype(np.int64), 0,
+                         self.table_size - 1)
+        np.add.at(self._pos, bucket, w * label)
+        np.add.at(self._neg, bucket, w * (1.0 - label))
+        err = pred - label
+        self._scalars += [np.sum(w * np.abs(err)), np.sum(w * err * err),
+                          np.sum(w * pred), np.sum(w * label), np.sum(w)]
+
+    # -- merge device accumulator state -------------------------------------
+    def merge_device_state(self, state) -> None:
+        self._pos += np.asarray(state["pos"], np.float64)
+        self._neg += np.asarray(state["neg"], np.float64)
+        self._scalars += np.asarray(state["scalars"], np.float64)
+
+    # -- final reduction (≙ compute() metrics.cc:284) -----------------------
+    def compute(self) -> Dict[str, float]:
+        pos, neg = self._pos, self._neg
+        # trapezoid sweep from the top bucket down (metrics.cc:314-320)
+        tp_cum = np.cumsum(pos[::-1])
+        fp_cum = np.cumsum(neg[::-1])
+        tp_prev = np.concatenate([[0.0], tp_cum[:-1]])
+        fp_prev = np.concatenate([[0.0], fp_cum[:-1]])
+        area = np.sum((fp_cum - fp_prev) * (tp_prev + tp_cum) / 2.0)
+        fp, tp = fp_cum[-1], tp_cum[-1]
+        if fp < 1e-3 or tp < 1e-3:
+            auc = -0.5  # all-positive or all-negative (metrics.cc:321)
+        else:
+            auc = area / (fp * tp)
+        size = fp + tp
+        abserr, sqrerr, pred_sum, label_sum, total = self._scalars
+        out = {
+            "auc": float(auc),
+            "size": float(size),
+            "mae": float(abserr / size) if size else 0.0,
+            "rmse": float(math.sqrt(sqrerr / size)) if size else 0.0,
+            "actual_ctr": float(tp / size) if size else 0.0,
+            "predicted_ctr": float(pred_sum / size) if size else 0.0,
+            "bucket_error": self._bucket_error(),
+        }
+        return out
+
+    def _bucket_error(self) -> float:
+        """≙ calculate_bucket_error (metrics.cc:373-410): merge adjacent
+        buckets until the adjusted-ctr estimate is statistically tight, then
+        accumulate the relative error of actual vs adjusted ctr."""
+        last_ctr = -1.0
+        impression_sum = ctr_sum = click_sum = 0.0
+        error_sum = error_count = 0.0
+        nz = np.nonzero(self._pos + self._neg)[0]
+        for i in nz:
+            click = self._pos[i]
+            show = self._pos[i] + self._neg[i]
+            ctr = i / self.table_size
+            if abs(ctr - last_ctr) > K_MAX_SPAN:
+                last_ctr = ctr
+                impression_sum = ctr_sum = click_sum = 0.0
+            impression_sum += show
+            ctr_sum += ctr * show
+            click_sum += click
+            adjust_ctr = ctr_sum / impression_sum
+            if adjust_ctr <= 0 or adjust_ctr >= 1:
+                continue
+            relative_error = math.sqrt(
+                (1 - adjust_ctr) / (adjust_ctr * impression_sum))
+            if relative_error < K_RELATIVE_ERROR_BOUND:
+                actual = click_sum / impression_sum
+                error_sum += abs(actual / adjust_ctr - 1) * impression_sum
+                error_count += impression_sum
+                last_ctr = -1.0
+        return error_sum / error_count if error_count > 0 else 0.0
+
+
+class MetricGroup:
+    """Named metric registry with phase filtering (≙ BoxWrapper metric maps,
+    box_wrapper.h:769-792: InitMetric/UpdateMetric/GetMetricMsg; phases are
+    the join/update pass flip, ≙ FlipPhase box_wrapper.h:805)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, Dict] = {}
+        self.phase = 1  # 1 = join, 0 = update (reference convention)
+
+    def init_metric(self, name: str, label_var: str = "label",
+                    pred_var: str = "prob", phase: int = -1,
+                    table_size: int = TABLE_SIZE) -> None:
+        self._metrics[name] = {
+            "calc": AucCalculator(table_size),
+            "label_var": label_var, "pred_var": pred_var, "phase": phase,
+        }
+
+    def flip_phase(self) -> None:
+        self.phase = 1 - self.phase
+
+    def active(self) -> List[str]:
+        return [n for n, m in self._metrics.items()
+                if m["phase"] in (-1, self.phase)]
+
+    def update(self, name: str, pred, label, mask=None) -> None:
+        self._metrics[name]["calc"].add_data(pred, label, mask)
+
+    def merge_device_state(self, name: str, state) -> None:
+        self._metrics[name]["calc"].merge_device_state(state)
+
+    def calculator(self, name: str) -> AucCalculator:
+        return self._metrics[name]["calc"]
+
+    def get_metric_msg(self, name: str) -> Dict[str, float]:
+        return self._metrics[name]["calc"].compute()
+
+    def reset(self, name: Optional[str] = None) -> None:
+        for n, m in self._metrics.items():
+            if name is None or n == name:
+                m["calc"].reset()

@@ -1,0 +1,2 @@
+from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm  # noqa: F401
+from paddlebox_tpu.ops.cvm import cvm  # noqa: F401

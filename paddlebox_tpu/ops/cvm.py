@@ -1,0 +1,49 @@
+"""Standalone CVM op (≙ operators/cvm_op.{h,cc,cu}).
+
+Forward (cvm_op.h:35-36): for x = [show, click, embedx...]:
+    y0 = log(show + 1) ; y1 = log(click + 1) - log(show + 1)
+use_cvm=True keeps the transformed columns, False strips them.
+
+Backward (CvmGradComputeKernel, cvm_op.h:44-56) is deliberately NOT the
+analytic derivative: dx[2:] = dy[...], and dx[0:2] is set to the instance's
+raw (show, click) so the pushed "gradient" carries impression counts to the
+sparse optimizer (dy_mf_update_value, optimizer.cuh.h:84-97 reads them as
+g_show/g_click).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def cvm(x: jnp.ndarray, ins_cvm: jnp.ndarray, use_cvm: bool = True):
+    """x: [..., E] with E >= 2 (cols 0,1 = show, click); ins_cvm: [..., 2]."""
+    return _cvm_fwd_impl(x, use_cvm)
+
+
+def _cvm_fwd_impl(x, use_cvm):
+    show = jnp.log(x[..., 0:1] + 1.0)
+    click = jnp.log(x[..., 1:2] + 1.0) - show
+    if use_cvm:
+        return jnp.concatenate([show, click, x[..., 2:]], axis=-1)
+    return x[..., 2:]
+
+
+def _cvm_fwd(x, ins_cvm, use_cvm):
+    return _cvm_fwd_impl(x, use_cvm), (ins_cvm, x.shape)
+
+
+def _cvm_bwd(use_cvm, res, dy):
+    ins_cvm, x_shape = res
+    if use_cvm:
+        d_embedx = dy[..., 2:]
+    else:
+        d_embedx = dy
+    dx = jnp.concatenate([ins_cvm.astype(dy.dtype), d_embedx], axis=-1)
+    return dx, jnp.zeros_like(ins_cvm)
+
+
+cvm.defvjp(_cvm_fwd, _cvm_bwd)

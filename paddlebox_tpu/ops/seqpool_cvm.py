@@ -1,0 +1,138 @@
+"""Fused per-slot sequence sum-pool + CVM transform.
+
+≙ the fused_seqpool_cvm op family (fused/fused_seqpool_cvm_op.cu — seqpool
+kernels :35-369, CVM stage FusedCVMKernelWithCVM :371, grad
+FusedSeqpoolCVMGradKernelWithCVM :814; attr surface
+fused_seqpool_cvm_op.cc:113-146).
+
+TPU-first shape contract: instead of per-slot ragged LoD tensors, input is the
+batch-pack layout ``emb [S, B, L, E]`` (slot, instance, key-capacity,
+embedding) with per-(slot, instance) ``lengths`` — a masked sum over L that
+XLA fuses with the upstream gather and downstream matmul; no scalar loops.
+
+Supported attrs (parity with the CUDA variants):
+- pad_value          : init value of each pooled output element
+- use_cvm            : keep (log-transformed) show/click cols or strip them
+- quant              : quant_ratio > 0 rounds embedx to the quant grid
+                       (FusedSeqpoolKernelQuant :59)
+- need_filter        : drop keys with show_coeff*(show-click)+clk_coeff*click
+                       < threshold (FusedSeqpoolKernelQuantFilter :139)
+- embed_threshold    : additionally drop keys whose embedx L2-ish score is
+                       below embed_threshold (KernelEmbedQuantFilter :230)
+
+Backward mirrors the reference exactly (NOT analytic AD): embedx grads are
+the pooled-output grads broadcast over the valid keys; show/click grad
+columns carry the *instance* show/click so pushes accumulate counts
+(see ops/cvm.py docstring).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+CVM_OFFSET = 2  # show, click
+
+
+def _quantize(x, quant_ratio):
+    return jnp.floor(x * quant_ratio + 0.5) / quant_ratio
+
+
+def _pool(emb, lengths, pad_value, quant_ratio, need_filter,
+          show_coeff, clk_coeff, threshold, embed_threshold,
+          embed_thres_size):
+    S, B, L, E = emb.shape
+    keymask = (jnp.arange(L)[None, None, :] < lengths[:, :, None])  # [S,B,L]
+    if need_filter:
+        show = emb[..., 0]
+        click = emb[..., 1]
+        keep = (show - click) * show_coeff + click * clk_coeff >= threshold
+        if embed_threshold > 0:
+            embedx = emb[..., CVM_OFFSET:CVM_OFFSET + embed_thres_size]
+            score = (jnp.sqrt(jnp.sum(embedx[..., 1:] ** 2, axis=-1))
+                     + jnp.abs(embedx[..., 0]))
+            keep = keep & (score >= embed_threshold)
+        keymask = keymask & keep
+    w = keymask.astype(emb.dtype)[..., None]
+    if quant_ratio > 0:
+        embedx_q = _quantize(emb[..., CVM_OFFSET:], quant_ratio)
+        vals = jnp.concatenate([emb[..., :CVM_OFFSET], embedx_q], axis=-1)
+    else:
+        vals = emb
+    pooled = pad_value + jnp.sum(vals * w, axis=2)  # [S, B, E]
+    return pooled, keymask
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+def fused_seqpool_cvm(emb: jnp.ndarray, lengths: jnp.ndarray,
+                      ins_cvm: jnp.ndarray,
+                      use_cvm: bool = True, pad_value: float = 0.0,
+                      quant_ratio: int = 0, need_filter: bool = False,
+                      show_coeff: float = 0.2, clk_coeff: float = 1.0,
+                      threshold: float = 0.96,
+                      embed_threshold: float = 0.0,
+                      embed_thres_size: int = 0) -> jnp.ndarray:
+    """emb [S,B,L,E], lengths [S,B] int, ins_cvm [B,2] → [B, S*E] (use_cvm)
+    or [B, S*(E-2)]."""
+    out, _ = _fwd_impl(emb, lengths, use_cvm, pad_value, quant_ratio,
+                       need_filter, show_coeff, clk_coeff, threshold,
+                       embed_threshold, embed_thres_size)
+    return out
+
+
+def _fwd_impl(emb, lengths, use_cvm, pad_value, quant_ratio, need_filter,
+              show_coeff, clk_coeff, threshold, embed_threshold,
+              embed_thres_size):
+    S, B, L, E = emb.shape
+    pooled, keymask = _pool(emb, lengths, pad_value, quant_ratio,
+                            need_filter, show_coeff, clk_coeff, threshold,
+                            embed_threshold, embed_thres_size)
+    show = jnp.log(pooled[..., 0:1] + 1.0)
+    click = jnp.log(pooled[..., 1:2] + 1.0) - show
+    if use_cvm:
+        out = jnp.concatenate([show, click, pooled[..., CVM_OFFSET:]], axis=-1)
+        width = E
+    else:
+        out = pooled[..., CVM_OFFSET:]
+        width = E - CVM_OFFSET
+    # [S, B, width] → [B, S*width] slot-major concat (≙ the per-slot output
+    # tensors the reference's consumers concat)
+    out = jnp.transpose(out, (1, 0, 2)).reshape(B, S * width)
+    return out, keymask
+
+
+def _fwd(emb, lengths, ins_cvm, use_cvm, pad_value, quant_ratio, need_filter,
+         show_coeff, clk_coeff, threshold, embed_threshold, embed_thres_size):
+    out, keymask = _fwd_impl(emb, lengths, use_cvm, pad_value, quant_ratio,
+                             need_filter, show_coeff, clk_coeff, threshold,
+                             embed_threshold, embed_thres_size)
+    return out, (keymask, ins_cvm)
+
+
+def _bwd(use_cvm, pad_value, quant_ratio, need_filter, show_coeff, clk_coeff,
+         threshold, embed_threshold, embed_thres_size, res, dy):
+    keymask, ins_cvm = res
+    S, B, L = keymask.shape
+    emb_dtype = dy.dtype
+    width = dy.shape[1] // S
+    dy = dy.reshape(B, S, width).transpose(1, 0, 2)  # [S, B, width]
+    if use_cvm:
+        d_embedx = dy[..., CVM_OFFSET:]
+    else:
+        d_embedx = dy
+    # show/click grad columns carry instance counts
+    # (FusedSeqpoolCVMGradKernelWithCVM :828-830 reads cvm_values)
+    d_cvm = jnp.broadcast_to(ins_cvm[None, :, :].astype(emb_dtype),
+                             (S, B, CVM_OFFSET))
+    d_pooled = jnp.concatenate([d_cvm, d_embedx], axis=-1)  # [S, B, E]
+    w = keymask.astype(emb_dtype)[..., None]
+    d_emb = d_pooled[:, :, None, :] * w  # broadcast over valid keys
+    d_lengths = np.zeros((S, B), dtype=jax.dtypes.float0)
+    return d_emb, d_lengths, jnp.zeros_like(ins_cvm)
+
+
+fused_seqpool_cvm.defvjp(_fwd, _bwd)

@@ -1,0 +1,126 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
+from paddlebox_tpu.ops.cvm import cvm
+
+
+def ref_seqpool_cvm(emb, lengths, use_cvm=True, pad_value=0.0,
+                    quant_ratio=0, need_filter=False, show_coeff=0.2,
+                    clk_coeff=1.0, threshold=0.96):
+    """NumPy golden model implementing the CUDA kernel semantics
+    (fused_seqpool_cvm_op.cu:35-160,371-395) with scalar loops."""
+    S, B, L, E = emb.shape
+    out_width = E if use_cvm else E - 2
+    out = np.zeros((B, S * out_width), np.float64)
+    for s in range(S):
+        for b in range(B):
+            pooled = np.full((E,), 0.0, np.float64)
+            pooled += pad_value
+            for l in range(int(lengths[s, b])):
+                v = emb[s, b, l].astype(np.float64)
+                if need_filter and ((v[0] - v[1]) * show_coeff
+                                    + v[1] * clk_coeff < threshold):
+                    continue
+                for e in range(E):
+                    if e < 2 or quant_ratio <= 0:
+                        pooled[e] += v[e]
+                    else:
+                        pooled[e] += np.floor(
+                            v[e] * quant_ratio + 0.5) / quant_ratio
+            show = np.log(pooled[0] + 1)
+            click = np.log(pooled[1] + 1) - show
+            if use_cvm:
+                res = np.concatenate([[show, click], pooled[2:]])
+            else:
+                res = pooled[2:]
+            out[b, s * out_width:(s + 1) * out_width] = res
+    return out
+
+
+def make_inputs(seed=0, S=3, B=4, L=5, E=6):
+    rng = np.random.default_rng(seed)
+    emb = rng.uniform(0, 2, size=(S, B, L, E)).astype(np.float32)
+    lengths = rng.integers(0, L + 1, size=(S, B)).astype(np.int32)
+    ins_cvm = np.stack([np.ones(B), rng.integers(0, 2, B)], 1).astype(np.float32)
+    return emb, lengths, ins_cvm
+
+
+def test_forward_use_cvm():
+    emb, lengths, ins_cvm = make_inputs()
+    got = fused_seqpool_cvm(emb, lengths, ins_cvm, True)
+    want = ref_seqpool_cvm(emb, lengths, use_cvm=True)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_forward_no_cvm_strips_columns():
+    emb, lengths, ins_cvm = make_inputs(1)
+    got = fused_seqpool_cvm(emb, lengths, ins_cvm, False)
+    want = ref_seqpool_cvm(emb, lengths, use_cvm=False)
+    assert got.shape == (4, 3 * 4)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_forward_quant_and_filter():
+    emb, lengths, ins_cvm = make_inputs(2)
+    got = fused_seqpool_cvm(emb, lengths, ins_cvm, True, 0.0, 128, True,
+                            0.2, 1.0, 0.96)
+    want = ref_seqpool_cvm(emb, lengths, use_cvm=True, quant_ratio=128,
+                           need_filter=True)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_backward_semantics():
+    """Grad must mirror FusedSeqpoolCVMGradKernelWithCVM: embedx grads are
+    dout broadcast over valid keys; show/click grad cols carry ins show/click."""
+    emb, lengths, ins_cvm = make_inputs(3)
+    S, B, L, E = emb.shape
+
+    def loss(e):
+        out = fused_seqpool_cvm(e, lengths, ins_cvm, True)
+        return jnp.sum(out * jnp.arange(out.size).reshape(out.shape))
+
+    g = jax.grad(loss)(jnp.asarray(emb))
+    g = np.asarray(g)
+    dy = np.arange(B * S * E).reshape(B, S * E).astype(np.float64)
+    for s in range(S):
+        for b in range(B):
+            for l in range(L):
+                valid = l < lengths[s, b]
+                expect_sc = ins_cvm[b] if valid else [0, 0]
+                np.testing.assert_allclose(g[s, b, l, :2], expect_sc,
+                                           rtol=1e-6)
+                expect_x = dy[b, s * E + 2:(s + 1) * E] if valid else \
+                    np.zeros(E - 2)
+                np.testing.assert_allclose(g[s, b, l, 2:], expect_x, rtol=1e-6)
+
+
+def test_backward_under_jit():
+    emb, lengths, ins_cvm = make_inputs(4)
+
+    @jax.jit
+    def f(e):
+        return jax.grad(
+            lambda x: jnp.sum(fused_seqpool_cvm(x, lengths, ins_cvm, True))
+        )(e)
+
+    g = f(jnp.asarray(emb))
+    assert g.shape == emb.shape
+
+
+def test_cvm_op():
+    x = np.array([[3.0, 1.0, 0.5, -0.5]], np.float32)
+    ins = np.array([[1.0, 1.0]], np.float32)
+    y = cvm(jnp.asarray(x), jnp.asarray(ins), True)
+    np.testing.assert_allclose(
+        np.asarray(y)[0],
+        [np.log(4), np.log(2) - np.log(4), 0.5, -0.5], rtol=1e-6)
+    y2 = cvm(jnp.asarray(x), jnp.asarray(ins), False)
+    np.testing.assert_allclose(np.asarray(y2)[0], [0.5, -0.5], rtol=1e-6)
+    # grad: show/click cols carry ins_cvm, embedx passes dout through
+    g = jax.grad(lambda a: jnp.sum(cvm(a, jnp.asarray(ins), True) *
+                                   jnp.array([[1., 2., 3., 4.]])))(
+        jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g)[0], [1.0, 1.0, 3.0, 4.0],
+                               rtol=1e-6)
