@@ -1,0 +1,2 @@
+from paddlebox_tpu.ps.host_table import ShardedHostTable  # noqa: F401
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine  # noqa: F401

@@ -1,0 +1,156 @@
+"""Device-resident pass working set: pull (gather) and push (scatter-add).
+
+TPU-native replacement for the HBM hash table + HeterComm all2all
+(hashtable.h:114, heter_comm_inl.h:1117-1996) and the BoxWrapper pull/push
+hot path (box_wrapper_impl.h:25-632, copy kernels box_wrapper.cu:75-600):
+
+* key→row translation happens ON HOST at batch-pack time against the pass's
+  sorted unique key array (PassKeyMapper below, ≙ DedupKeysAndFillIdx +
+  build-pass dedup PreBuildTask ps_gpu_wrapper.cc:114) — so the device side
+  is a pure dense-index gather/scatter that XLA tiles onto the MXU/HBM with
+  no hash probes or dynamic shapes;
+* cross-chip routing is GSPMD: the working set is row-sharded over the mesh
+  (HybridTopology.table_spec) and jit-compiled gathers lower to the same
+  all-to-all pattern HeterComm hand-codes.
+
+Row 0 is the reserved zero row: padding positions and (optionally) key 0 pull
+zeros and push nothing (≙ FLAGS_enable_pull_box_padding_zero).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.config import EmbeddingTableConfig
+
+# Device pytree fields (all [N] except mf/mf_g2sum)
+DEVICE_FIELDS = ("show", "click", "delta_score", "slot", "embed_w",
+                 "embed_g2sum", "mf_size", "mf_g2sum", "mf")
+
+
+def round_up(n: int, align: int) -> int:
+    return ((n + align - 1) // align) * align
+
+
+def size_bucket(n: int, align: int = 8) -> int:
+    """Grow-only size buckets so per-pass working sets of similar size reuse
+    the same compiled step (≙ DCacheBuffer grow-only realloc,
+    box_wrapper.h:198)."""
+    n = max(n, align)
+    bucket = align
+    while bucket < n:
+        bucket *= 2
+    # intermediate steps between powers of two cap padding waste at ~14%
+    for frac in (5 * bucket // 8, 3 * bucket // 4, 7 * bucket // 8):
+        if frac >= n and frac % align == 0:
+            return frac
+    return bucket
+
+
+class PassKeyMapper:
+    """Host-side key→pass-row translation over the sorted unique key array.
+
+    Row 0 is reserved (zero row); real keys map to rows 1..n.
+    """
+
+    def __init__(self, sorted_keys: np.ndarray):
+        self.sorted_keys = sorted_keys  # unique, ascending, excludes 0
+
+    def __call__(self, keys: np.ndarray) -> np.ndarray:
+        if len(self.sorted_keys) == 0:
+            return np.zeros(len(keys), np.int32)
+        pos = np.searchsorted(self.sorted_keys, keys)
+        pos_c = np.minimum(pos, len(self.sorted_keys) - 1)
+        found = self.sorted_keys[pos_c] == keys
+        return np.where(found, pos_c + 1, 0).astype(np.int32)
+
+    @property
+    def num_keys(self) -> int:
+        return len(self.sorted_keys)
+
+
+def build_working_set(host_soa: Dict[str, np.ndarray], mf_dim: int,
+                      pad_to: Optional[int] = None,
+                      sharding=None) -> Dict[str, jnp.ndarray]:
+    """Assemble the device pytree from host rows (row 0 = zeros) and place it
+    with the given NamedSharding (row-sharded over the mesh).
+
+    ≙ BuildGPUTask's HBM pool fill (ps_gpu_wrapper.cc:684-760) — a single
+    chunked H2D per field instead of 500k-key memcpy loops.
+    """
+    n = len(host_soa["show"])
+    total = (pad_to if pad_to is not None else size_bucket(n + 1))
+    assert total >= n + 1
+    ws = {}
+    for f in DEVICE_FIELDS:
+        src = host_soa[f]
+        shape = (total,) + src.shape[1:]
+        arr = np.zeros(shape, src.dtype)
+        arr[1:n + 1] = src
+        dtype = jnp.int32 if src.dtype == np.int32 else jnp.float32
+        if sharding is not None:
+            ws[f] = jax.device_put(arr.astype(dtype), sharding)
+        else:
+            ws[f] = jnp.asarray(arr, dtype=dtype)
+    return ws
+
+
+def dump_working_set(ws: Dict[str, jnp.ndarray], n: int
+                     ) -> Dict[str, np.ndarray]:
+    """Device→host for end_pass write-back (≙ dump_pool_to_cpu_func,
+    ps_gpu_wrapper.cc:983+ / accessor DumpFill)."""
+    return {f: np.asarray(ws[f])[1:n + 1] for f in DEVICE_FIELDS}
+
+
+def pull_sparse(ws: Dict[str, jnp.ndarray], indices: jnp.ndarray
+                ) -> jnp.ndarray:
+    """Gather pull values [*, 3+D]: (show, click, embed_w, embedx×D).
+
+    ≙ PullSparseCaseGPU + CopyForPull (box_wrapper_impl.h:25,
+    box_wrapper.cu:945).  mf is masked until created (mf_size>0 —
+    CommonPullValue semantics, feature_value.h:161).
+    """
+    show = ws["show"][indices]
+    click = ws["click"][indices]
+    embed_w = ws["embed_w"][indices]
+    created = (ws["mf_size"][indices] > 0).astype(ws["mf"].dtype)
+    mf = ws["mf"][indices] * created[..., None]
+    return jnp.concatenate(
+        [show[..., None], click[..., None], embed_w[..., None], mf], axis=-1)
+
+
+def push_sparse_grads(ws: Dict[str, jnp.ndarray], indices: jnp.ndarray,
+                      grads: jnp.ndarray, slot_ids: jnp.ndarray
+                      ) -> Dict[str, jnp.ndarray]:
+    """Accumulate per-row push values by scatter-add (merge-by-key,
+    ≙ PushMergeCopyAtomic box_wrapper.cu:476 / dynamic_merge_grad).
+
+    indices: [S,B,L] pass rows; grads: [S,B,L,3+D] where cols are
+    (g_show, g_click, g_embed, g_embedx...); slot_ids: [S] int32.
+    Returns accumulators dict with g_show/g_click/g_embed/g_embedx [N(,D)]
+    and the per-row slot id.  Row 0 (padding) accumulates too but is ignored
+    by the optimizer mask.
+    """
+    n = ws["show"].shape[0]
+    flat_idx = indices.reshape(-1)
+    flat_g = grads.reshape(-1, grads.shape[-1])
+    S, B, L = indices.shape
+    flat_slot = jnp.broadcast_to(
+        slot_ids[:, None, None], (S, B, L)).reshape(-1)
+    # padding / masked positions carry all-zero grads already (seqpool bwd
+    # masks by key validity); zero their index to the reserved row anyway.
+    zeros = jnp.zeros((n,), flat_g.dtype)
+    acc = {
+        "g_show": zeros.at[flat_idx].add(flat_g[:, 0]),
+        "g_click": zeros.at[flat_idx].add(flat_g[:, 1]),
+        "g_embed": zeros.at[flat_idx].add(flat_g[:, 2]),
+        "g_embedx": jnp.zeros_like(ws["mf"]).at[flat_idx].add(flat_g[:, 3:]),
+        "slot": jnp.zeros((n,), jnp.int32).at[flat_idx].max(
+            flat_slot.astype(jnp.int32)),
+    }
+    return acc

@@ -1,0 +1,78 @@
+"""Feature value schema — struct-of-arrays on host and device.
+
+≙ CommonFeatureValue (heter_ps/feature_value.h:44-57 layout comment:
+delta_score, show, click, slot, embed_w, embed_g2sum, mf_dim, mf_size,
+mf_g2sum?, embedx...) and CommonPullValue/CommonPushValue
+(feature_value.h:161,185).  Instead of packed float rows with index
+arithmetic, each field is its own array — the layout XLA/TPU wants (no
+byte-offset gymnastics, every field contiguously vectorizable).
+
+Pull value layout delivered to the model is [show, click, embed_w,
+embedx x D] — the first two columns feed the CVM transform (cvm_offset=2),
+col 2 is the lr/"join" scalar weight (what PaddleBox models call the q value).
+Push value is the same width plus implicit slot: [g_show, g_click, g_embed,
+g_embedx x D].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import numpy as np
+
+CVM_COLS = 2          # show, click
+PULL_EXTRA = 3        # show, click, embed_w
+
+
+HOST_FIELDS = (
+    # (name, dtype, per-key shape suffix)
+    ("show", np.float32, ()),
+    ("click", np.float32, ()),
+    ("delta_score", np.float32, ()),
+    ("slot", np.int32, ()),
+    ("embed_w", np.float32, ()),
+    ("embed_g2sum", np.float32, ()),
+    ("mf_size", np.int32, ()),      # 0 until mf created (lazy, threshold)
+    ("mf_g2sum", np.float32, ()),
+    ("unseen_days", np.float32, ()),
+    ("mf", np.float32, ("D",)),     # embedx weights (random candidate init
+                                    # until mf_size > 0 — see optimizer.py)
+)
+
+
+def empty_soa(n: int, mf_dim: int) -> Dict[str, np.ndarray]:
+    out = {}
+    for name, dtype, suffix in HOST_FIELDS:
+        shape = (n,) + tuple(mf_dim if s == "D" else s for s in suffix)
+        out[name] = np.zeros(shape, dtype=dtype)
+    return out
+
+
+def default_rows(n: int, mf_dim: int, rng: np.random.Generator,
+                 mf_initial_range: float, initial_range: float = 0.0
+                 ) -> Dict[str, np.ndarray]:
+    """Fresh feature rows for keys unseen by the host table.
+
+    embed_w ~ U(-initial_range, initial_range) (CPU rule init; default range 0
+    ⇒ 0, optimizer_conf.h:29); mf gets its creation-time candidate init
+    ~ U(0, mf_initial_range) (≙ curand_uniform * mf_initial_range,
+    optimizer.cuh.h:119-121) which stays masked until mf_size > 0.
+    """
+    soa = empty_soa(n, mf_dim)
+    if initial_range > 0:
+        soa["embed_w"] = rng.uniform(
+            -initial_range, initial_range, size=(n,)).astype(np.float32)
+    soa["mf"] = rng.uniform(
+        0.0, mf_initial_range, size=(n, mf_dim)).astype(np.float32)
+    return soa
+
+
+def select_rows(soa: Dict[str, np.ndarray], idx: np.ndarray
+                ) -> Dict[str, np.ndarray]:
+    return {k: v[idx] for k, v in soa.items()}
+
+
+def concat_soa(parts) -> Dict[str, np.ndarray]:
+    keys = parts[0].keys()
+    return {k: np.concatenate([p[k] for p in parts]) for k in keys}

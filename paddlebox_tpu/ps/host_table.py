@@ -1,0 +1,179 @@
+"""Host DRAM tier of the tiered parameter server.
+
+≙ MemorySparseTable (ps/table/memory_sparse_table.{h,cc}): shard by
+``key % shard_num`` (memory_sparse_table.h:46-59), bulk Pull/Push
+(:61-97), Save/Load with per-shard files, Shrink via accessor policy.
+
+TPU-first storage: each shard keeps its keys in one *sorted* uint64 array
+with parallel SoA value arrays — bulk lookup is a vectorized
+``np.searchsorted`` and pass-level merge is an O(n) sorted union, matching
+the pass-batched access pattern (one pull at end_feed_pass, one write-back at
+end_pass) instead of the reference's per-request hash probes.  A native C++
+hash shard (paddlebox_tpu/native/) can be slotted in for point lookups.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.config import EmbeddingTableConfig
+from paddlebox_tpu.ps import feature_value as fv
+
+
+class _Shard:
+    def __init__(self, mf_dim: int):
+        self.keys = np.empty((0,), np.uint64)
+        self.soa = fv.empty_soa(0, mf_dim)
+        self.mf_dim = mf_dim
+        self.lock = threading.Lock()
+
+    @property
+    def size(self) -> int:
+        return len(self.keys)
+
+    def lookup(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """→ (positions, found_mask); positions valid where found."""
+        if len(self.keys) == 0:
+            return (np.zeros(len(keys), np.int64),
+                    np.zeros(len(keys), bool))
+        pos = np.searchsorted(self.keys, keys)
+        pos_c = np.minimum(pos, len(self.keys) - 1)
+        found = self.keys[pos_c] == keys
+        return pos_c, found
+
+    def upsert(self, keys: np.ndarray, soa: Dict[str, np.ndarray]) -> None:
+        """Sorted-merge write: overwrite existing rows, insert new ones."""
+        with self.lock:
+            pos, found = self.lookup(keys)
+            if found.any():
+                idx = pos[found]
+                for f, arr in self.soa.items():
+                    arr[idx] = soa[f][found]
+            if (~found).any():
+                new_keys = keys[~found]
+                merged_keys = np.concatenate([self.keys, new_keys])
+                order = np.argsort(merged_keys, kind="stable")
+                self.keys = merged_keys[order]
+                for f in self.soa:
+                    merged = np.concatenate(
+                        [self.soa[f], soa[f][~found]])
+                    self.soa[f] = merged[order]
+
+
+class ShardedHostTable:
+    """DRAM embedding table, pass-batched API."""
+
+    def __init__(self, config: EmbeddingTableConfig, seed: int = 0):
+        self.config = config
+        self.mf_dim = config.embedding_dim
+        self.shard_num = config.shard_num
+        self._shards = [_Shard(self.mf_dim) for _ in range(self.shard_num)]
+        self._rng = np.random.default_rng(seed)
+
+    # -- introspection -------------------------------------------------------
+    def size(self) -> int:
+        return sum(s.size for s in self._shards)
+
+    def _shard_ids(self, keys: np.ndarray) -> np.ndarray:
+        return (keys % np.uint64(self.shard_num)).astype(np.int64)
+
+    # -- pass-batched pull/push ---------------------------------------------
+    def bulk_pull(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
+        """Read rows for unique `keys` (read-only; unseen keys get fresh
+        default rows — insertion happens at write-back, matching the
+        build-pass flow ps_gpu_wrapper.cc:337-760)."""
+        n = len(keys)
+        out = fv.default_rows(n, self.mf_dim, self._rng,
+                              self.config.sgd.mf_initial_range,
+                              self.config.sgd.initial_range)
+        sid = self._shard_ids(keys)
+        for s, shard in enumerate(self._shards):
+            sel = np.nonzero(sid == s)[0]
+            if not len(sel):
+                continue
+            pos, found = shard.lookup(keys[sel])
+            hit = sel[found]
+            if len(hit):
+                src = pos[found]
+                for f, arr in shard.soa.items():
+                    out[f][hit] = arr[src]
+        return out
+
+    def bulk_write(self, keys: np.ndarray, soa: Dict[str, np.ndarray]) -> None:
+        sid = self._shard_ids(keys)
+        for s, shard in enumerate(self._shards):
+            sel = np.nonzero(sid == s)[0]
+            if len(sel):
+                shard.upsert(keys[sel], fv.select_rows(soa, sel))
+
+    # -- lifecycle policy (≙ CtrCommonAccessor, ctr_accessor.cc) ------------
+    def _score(self, soa: Dict[str, np.ndarray]) -> np.ndarray:
+        sgd = self.config.sgd
+        return (sgd.nonclk_coeff * (soa["show"] - soa["click"])
+                + sgd.clk_coeff * soa["click"])
+
+    def end_day(self) -> None:
+        """Day rollover: decay show/click, age unseen features
+        (≙ CtrCommonAccessor::UpdateStatAfterSave / show_click_decay)."""
+        decay = self.config.accessor.show_click_decay_rate
+        for shard in self._shards:
+            with shard.lock:
+                shard.soa["show"] *= decay
+                shard.soa["click"] *= decay
+                shard.soa["unseen_days"] += 1.0
+
+    def shrink(self) -> int:
+        """Evict dead features (≙ Table::Shrink via accessor thresholds:
+        score < delete_threshold or unseen too long)."""
+        acc = self.config.accessor
+        removed = 0
+        for shard in self._shards:
+            with shard.lock:
+                score = self._score(shard.soa)
+                keep = ~((score < acc.delete_threshold) |
+                         (shard.soa["unseen_days"] > acc.delete_after_unseen_days))
+                removed += int((~keep).sum())
+                shard.keys = shard.keys[keep]
+                for f in shard.soa:
+                    shard.soa[f] = shard.soa[f][keep]
+        return removed
+
+    # -- persistence (≙ SaveBase/SaveDelta box_wrapper.cc:1286; per-shard
+    #    files with .shard suffix, memory_sparse_table.h:34) ----------------
+    def save(self, path: str, mode: str = "base") -> int:
+        os.makedirs(path, exist_ok=True)
+        acc = self.config.accessor
+        saved = 0
+        for i, shard in enumerate(self._shards):
+            with shard.lock:
+                score = self._score(shard.soa)
+                if mode == "base":
+                    keep = score >= acc.base_threshold
+                elif mode == "delta":
+                    keep = np.abs(shard.soa["delta_score"]) >= acc.delta_threshold
+                else:  # "all" / checkpoint
+                    keep = np.ones(shard.size, bool)
+                data = {f: arr[keep] for f, arr in shard.soa.items()}
+                data["keys"] = shard.keys[keep]
+                np.savez(os.path.join(path, f"part-{i:05d}.shard.npz"), **data)
+                saved += int(keep.sum())
+                if mode == "delta":
+                    shard.soa["delta_score"][keep] = 0.0
+        return saved
+
+    def load(self, path: str) -> int:
+        loaded = 0
+        for i, shard in enumerate(self._shards):
+            f = os.path.join(path, f"part-{i:05d}.shard.npz")
+            if not os.path.exists(f):
+                continue
+            with np.load(f) as z:
+                with shard.lock:
+                    shard.keys = z["keys"]
+                    shard.soa = {name: z[name] for name in shard.soa}
+            loaded += shard.size
+        return loaded

@@ -1,0 +1,141 @@
+"""Pass lifecycle engine — the BoxWrapper/BoxHelper equivalent.
+
+≙ BoxWrapper (box_wrapper.h:377) + BoxHelper (box_wrapper.h:1043) + the
+open-source PSGPUWrapper pass machinery (ps_gpu_wrapper.cc:114-1007):
+
+  set_date            ≙ BoxHelper::SetDate (box_wrapper.h:1048)
+  begin_feed_pass     ≙ BeginFeedPass (box_wrapper.cc:129) — opens a key
+                        collection agent for the loading pass
+  add_keys            ≙ PSAgent::AddKey via MergeInsKeys (data_set.cc:2293)
+  end_feed_pass       ≙ EndFeedPass (box_wrapper.cc:152) — dedups the pass
+                        keys (≙ PreBuildTask ps_gpu_wrapper.cc:114), pulls
+                        rows from the host table (≙ BuildPull :337) and
+                        builds the device working set (≙ BuildGPUTask :684)
+  begin_pass/end_pass ≙ box_wrapper.cc:171,186 — end_pass flushes the
+                        working set back to the DRAM tier
+                        (≙ EndPass dump_pool_to_cpu ps_gpu_wrapper.cc:983)
+  save_base/save_delta≙ SaveBase/SaveDelta (box_wrapper.cc:1286)
+  load                ≙ InitializeGPUAndLoadModel (box_wrapper.h:624)
+  shrink              ≙ ShrinkTable (box_wrapper.h:638)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddlebox_tpu.config import EmbeddingTableConfig
+from paddlebox_tpu.parallel.topology import HybridTopology
+from paddlebox_tpu.ps import embedding
+from paddlebox_tpu.ps.host_table import ShardedHostTable
+from paddlebox_tpu.utils.timer import TimerRegistry
+
+
+class BoxPSEngine:
+    def __init__(self, config: Optional[EmbeddingTableConfig] = None,
+                 topology: Optional[HybridTopology] = None, seed: int = 0):
+        self.config = config or EmbeddingTableConfig()
+        self.topology = topology
+        self.table = ShardedHostTable(self.config, seed=seed)
+        self.timers = TimerRegistry()
+        self.day_id: Optional[str] = None
+        self.pass_id = 0
+        self.phase = 1  # join/update flip (≙ FlipPhase box_wrapper.h:805)
+
+        self._agent_lock = threading.Lock()
+        self._agent_keys: List[np.ndarray] = []
+        self._feeding = False
+
+        self.mapper: Optional[embedding.PassKeyMapper] = None
+        self.ws: Optional[Dict[str, jnp.ndarray]] = None
+        self.num_keys = 0
+
+    # -- date / phase --------------------------------------------------------
+    def set_date(self, date: str) -> None:
+        if self.day_id is not None and date != self.day_id:
+            with self.timers("end_day"):
+                self.table.end_day()
+        self.day_id = date
+
+    def flip_phase(self) -> None:
+        self.phase = 1 - self.phase
+
+    # -- feed pass -----------------------------------------------------------
+    def begin_feed_pass(self) -> None:
+        assert not self._feeding, "previous feed pass not closed"
+        with self._agent_lock:
+            self._agent_keys = []
+        self._feeding = True
+
+    def add_keys(self, keys: np.ndarray) -> None:
+        """Thread-safe feasign sink for dataset reader threads."""
+        if len(keys):
+            with self._agent_lock:
+                self._agent_keys.append(np.asarray(keys, np.uint64))
+
+    def end_feed_pass(self) -> None:
+        """Dedup pass keys, pull host rows, build the device working set."""
+        assert self._feeding
+        self._feeding = False
+        with self.timers("dedup_keys"):
+            with self._agent_lock:
+                parts = self._agent_keys
+                self._agent_keys = []
+            allk = np.concatenate(parts) if parts else \
+                np.empty((0,), np.uint64)
+            uniq = np.unique(allk)
+            uniq = uniq[uniq != 0]  # key 0 = reserved zero row
+        self.mapper = embedding.PassKeyMapper(uniq)
+        self.num_keys = len(uniq)
+        with self.timers("build_pull"):
+            host_rows = self.table.bulk_pull(uniq)
+        with self.timers("build_device"):
+            sharding = (self.topology.table_sharding()
+                        if self.topology is not None else None)
+            self.ws = embedding.build_working_set(
+                host_rows, self.config.embedding_dim, sharding=sharding)
+
+    # -- train pass ----------------------------------------------------------
+    def begin_pass(self) -> None:
+        assert self.ws is not None, "end_feed_pass must run before begin_pass"
+        self.pass_id += 1
+
+    def end_pass(self, need_save_delta: bool = False,
+                 delta_path: str = "") -> None:
+        """Write the trained working set back to the DRAM tier."""
+        assert self.ws is not None and self.mapper is not None
+        with self.timers("dump_to_cpu"):
+            soa = embedding.dump_working_set(self.ws, self.num_keys)
+            soa["unseen_days"] = np.zeros((self.num_keys,), np.float32)
+            self.table.bulk_write(self.mapper.sorted_keys, soa)
+        self.ws = None
+        if need_save_delta and delta_path:
+            self.save_delta(delta_path)
+
+    # -- persistence ---------------------------------------------------------
+    def save_base(self, path: str) -> int:
+        return self.table.save(path, mode="base")
+
+    def save_delta(self, path: str) -> int:
+        return self.table.save(path, mode="delta")
+
+    def save_checkpoint(self, path: str) -> int:
+        return self.table.save(path, mode="all")
+
+    def load(self, path: str) -> int:
+        return self.table.load(path)
+
+    def shrink(self) -> int:
+        return self.table.shrink()
+
+    # -- convenience ---------------------------------------------------------
+    def attach_dataset(self, dataset) -> None:
+        """Register this engine as the dataset's feasign consumer
+        (≙ PadBoxSlotDataset holding the BoxWrapper agent)."""
+        dataset.register_key_consumer(self.add_keys)
+
+    def print_sync_timers(self) -> str:
+        return self.timers.report()

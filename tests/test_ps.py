@@ -1,0 +1,224 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.config import (AccessorConfig, EmbeddingTableConfig,
+                                  SparseSGDConfig)
+from paddlebox_tpu.ps import embedding, optimizer
+from paddlebox_tpu.ps.host_table import ShardedHostTable
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+
+
+def make_table(dim=4, **acc):
+    return ShardedHostTable(EmbeddingTableConfig(
+        embedding_dim=dim, shard_num=4, accessor=AccessorConfig(**acc)))
+
+
+def test_host_table_pull_write_roundtrip():
+    t = make_table()
+    keys = np.array([5, 17, 99999999999], np.uint64)
+    rows = t.bulk_pull(keys)
+    assert t.size() == 0  # pull is read-only
+    rows["show"][:] = [1.0, 2.0, 3.0]
+    rows["embed_w"][:] = [0.1, 0.2, 0.3]
+    t.bulk_write(keys, rows)
+    assert t.size() == 3
+    back = t.bulk_pull(np.array([17, 5], np.uint64))
+    np.testing.assert_allclose(back["show"], [2.0, 1.0])
+    np.testing.assert_allclose(back["embed_w"], [0.2, 0.1])
+    # overwrite + insert in one write
+    keys2 = np.array([17, 23], np.uint64)
+    rows2 = t.bulk_pull(keys2)
+    rows2["show"][:] = [20.0, 5.0]
+    t.bulk_write(keys2, rows2)
+    assert t.size() == 4
+    np.testing.assert_allclose(
+        t.bulk_pull(np.array([17], np.uint64))["show"], [20.0])
+
+
+def test_host_table_decay_and_shrink():
+    t = make_table(delete_threshold=0.5, delete_after_unseen_days=10)
+    keys = np.array([1, 2, 3], np.uint64)
+    rows = t.bulk_pull(keys)
+    rows["show"][:] = [100.0, 1.0, 100.0]
+    rows["click"][:] = [10.0, 0.0, 10.0]
+    t.bulk_write(keys, rows)
+    t.end_day()
+    rows = t.bulk_pull(keys)
+    np.testing.assert_allclose(rows["show"], [98.0, 0.98, 98.0])
+    assert (rows["unseen_days"] == 1.0).all()
+    # key 2 score = 0.1*0.98 < 0.5 → shrunk
+    assert t.shrink() == 1
+    assert t.size() == 2
+
+
+def test_host_table_save_load(tmp_path):
+    t = make_table(base_threshold=1.0)
+    keys = np.array([7, 8], np.uint64)
+    rows = t.bulk_pull(keys)
+    rows["show"][:] = [50.0, 0.1]   # score 5.0 vs 0.01
+    t.bulk_write(keys, rows)
+    saved = t.save(str(tmp_path / "base"), mode="base")
+    assert saved == 1  # only key 7 passes base threshold
+    t.save(str(tmp_path / "ckpt"), mode="all")
+    t2 = make_table(base_threshold=1.0)
+    assert t2.load(str(tmp_path / "ckpt")) == 2
+    np.testing.assert_allclose(
+        t2.bulk_pull(np.array([7], np.uint64))["show"], [50.0])
+
+
+def test_key_mapper():
+    m = embedding.PassKeyMapper(np.array([10, 20, 30], np.uint64))
+    got = m(np.array([30, 10, 999, 20, 0], np.uint64))
+    assert list(got) == [3, 1, 0, 2, 0]
+
+
+def test_size_bucket():
+    assert embedding.size_bucket(5) == 8
+    assert embedding.size_bucket(9) == 16  # 10,12,14 not aligned to 8
+    assert embedding.size_bucket(100) == 112
+    assert embedding.size_bucket(1000) == 1024
+    for n in (1, 7, 33, 777, 5000):
+        assert embedding.size_bucket(n) >= n + 0
+
+
+def test_pull_gather_and_mf_mask():
+    ws = {
+        "show": jnp.array([0.0, 5.0, 7.0]),
+        "click": jnp.array([0.0, 1.0, 2.0]),
+        "delta_score": jnp.zeros(3),
+        "slot": jnp.zeros(3, jnp.int32),
+        "embed_w": jnp.array([0.0, 0.5, -0.5]),
+        "embed_g2sum": jnp.zeros(3),
+        "mf_size": jnp.array([0, 0, 2], jnp.int32),
+        "mf_g2sum": jnp.zeros(3),
+        "mf": jnp.array([[0., 0.], [9., 9.], [1., 2.]]),
+    }
+    idx = jnp.array([[[1, 2, 0]]])  # [S=1,B=1,L=3]
+    out = np.asarray(embedding.pull_sparse(ws, idx))
+    # row 1: mf not created → zeros despite candidate init 9s
+    np.testing.assert_allclose(out[0, 0, 0], [5.0, 1.0, 0.5, 0.0, 0.0])
+    np.testing.assert_allclose(out[0, 0, 1], [7.0, 2.0, -0.5, 1.0, 2.0])
+    np.testing.assert_allclose(out[0, 0, 2], np.zeros(5))
+
+
+def test_push_accumulates_by_row():
+    n, d = 4, 2
+    ws = {"show": jnp.zeros(n), "mf": jnp.zeros((n, d))}
+    idx = jnp.array([[[1, 1], [2, 0]]])  # S=1,B=2,L=2
+    grads = jnp.array([[[[1., 1., 0.5, 0.1, 0.2],
+                         [1., 1., 0.5, 0.1, 0.2]],
+                        [[1., 0., 0.25, 0.3, 0.4],
+                         [0., 0., 0., 0., 0.]]]])
+    acc = embedding.push_sparse_grads(ws, idx, grads,
+                                      jnp.array([3], jnp.int32))
+    np.testing.assert_allclose(np.asarray(acc["g_show"]), [0., 2., 1., 0.])
+    np.testing.assert_allclose(np.asarray(acc["g_embed"]), [0., 1.0, 0.25, 0.])
+    np.testing.assert_allclose(np.asarray(acc["g_embedx"])[1], [0.2, 0.4])
+    assert np.asarray(acc["slot"])[1] == 3
+
+
+def ref_adagrad_row(cfg, show, click, g2sum, w, g_show, g_click, g_embed):
+    """Scalar golden model of dy_mf_update_value for the embed_w path."""
+    show2 = show + g_show
+    click2 = click + g_click
+    lr = cfg.feature_learning_rate
+    ratio = lr * np.sqrt(cfg.initial_g2sum / (cfg.initial_g2sum + g2sum))
+    sg = g_embed / g_show
+    w2 = np.clip(w + sg * ratio, cfg.min_bound, cfg.max_bound)
+    return show2, click2, w2, g2sum + sg * sg
+
+
+def test_sparse_adagrad_matches_reference_math():
+    cfg = SparseSGDConfig()
+    n, d = 3, 2
+    ws = {
+        "show": jnp.array([0., 4., 2.]),
+        "click": jnp.array([0., 1., 0.]),
+        "delta_score": jnp.zeros(n),
+        "slot": jnp.zeros(n, jnp.int32),
+        "embed_w": jnp.array([0., 0.3, -0.2]),
+        "embed_g2sum": jnp.array([0., 0.5, 0.1]),
+        "mf_size": jnp.array([0, d, 0], jnp.int32),
+        "mf_g2sum": jnp.zeros(n),
+        "mf": jnp.array([[0., 0.], [.5, .6], [.01, .02]]),
+    }
+    acc = {
+        "g_show": jnp.array([0., 2., 1.]),
+        "g_click": jnp.array([0., 1., 0.]),
+        "g_embed": jnp.array([0., 0.4, 0.2]),
+        "g_embedx": jnp.array([[0., 0.], [0.2, -0.2], [0.1, 0.1]]),
+        "slot": jnp.array([0, 5, 5], jnp.int32),
+    }
+    out = optimizer.sparse_adagrad_apply(ws, acc, cfg)
+    # row 1 golden
+    s2, c2, w2, g2 = ref_adagrad_row(cfg, 4., 1., 0.5, 0.3, 2., 1., 0.4)
+    assert np.isclose(float(out["show"][1]), s2)
+    assert np.isclose(float(out["click"][1]), c2)
+    assert np.isclose(float(out["embed_w"][1]), w2, rtol=1e-6)
+    assert np.isclose(float(out["embed_g2sum"][1]), g2, rtol=1e-6)
+    # delta score
+    want_delta = cfg.nonclk_coeff * (2. - 1.) + cfg.clk_coeff * 1.
+    assert np.isclose(float(out["delta_score"][1]), want_delta)
+    # row1 mf created before push → trains
+    ratio = cfg.mf_learning_rate * np.sqrt(
+        cfg.mf_initial_g2sum / cfg.mf_initial_g2sum)
+    sg = np.array([0.2, -0.2]) / 2.0
+    np.testing.assert_allclose(np.asarray(out["mf"][1]),
+                               np.array([.5, .6]) + sg * ratio, rtol=1e-6)
+    # row 2: score = 0.1*(2+1-0) + 1*0 = 0.3 < threshold 10 → mf not created
+    assert int(out["mf_size"][2]) == 0
+    np.testing.assert_allclose(np.asarray(out["mf"][2]), [.01, .02])
+    # row 0 untouched
+    assert float(out["show"][0]) == 0.0
+
+
+def test_mf_lazy_creation_threshold():
+    cfg = SparseSGDConfig(mf_create_thresholds=1.0)
+    n, d = 2, 2
+    ws = {
+        "show": jnp.array([0., 5.]), "click": jnp.array([0., 4.]),
+        "delta_score": jnp.zeros(n), "slot": jnp.zeros(n, jnp.int32),
+        "embed_w": jnp.zeros(n), "embed_g2sum": jnp.zeros(n),
+        "mf_size": jnp.zeros(n, jnp.int32), "mf_g2sum": jnp.zeros(n),
+        "mf": jnp.array([[0., 0.], [.3, .4]]),
+    }
+    acc = {
+        "g_show": jnp.array([0., 1.]), "g_click": jnp.array([0., 1.]),
+        "g_embed": jnp.zeros(n),
+        "g_embedx": jnp.ones((n, d)),
+        "slot": jnp.zeros(n, jnp.int32),
+    }
+    out = optimizer.sparse_adagrad_apply(ws, acc, cfg)
+    # score = 0.1*(6-5)+1*5 = 5.1 >= 1.0 → created now, keeps candidate init
+    assert int(out["mf_size"][1]) == d
+    np.testing.assert_allclose(np.asarray(out["mf"][1]), [.3, .4])
+
+
+def test_pass_lifecycle_end_to_end():
+    eng = BoxPSEngine(EmbeddingTableConfig(embedding_dim=2, shard_num=2))
+    eng.set_date("20260701")
+    eng.begin_feed_pass()
+    eng.add_keys(np.array([11, 22, 33, 22, 11], np.uint64))
+    eng.add_keys(np.array([44, 0], np.uint64))  # key 0 must be dropped
+    eng.end_feed_pass()
+    assert eng.num_keys == 4
+    assert eng.ws is not None
+    total = eng.ws["show"].shape[0]
+    assert total >= 5 and total % 8 == 0
+    eng.begin_pass()
+    # fake training: bump show on rows 1..4
+    eng.ws["show"] = eng.ws["show"].at[1:5].add(3.0)
+    eng.end_pass()
+    assert eng.ws is None
+    assert eng.table.size() == 4
+    back = eng.table.bulk_pull(np.array([11, 22, 33, 44], np.uint64))
+    np.testing.assert_allclose(back["show"], [3., 3., 3., 3.])
+    # second pass sees persisted values
+    eng.begin_feed_pass()
+    eng.add_keys(np.array([22, 55], np.uint64))
+    eng.end_feed_pass()
+    idx = eng.mapper(np.array([22, 55], np.uint64))
+    got = np.asarray(eng.ws["show"])[idx]
+    np.testing.assert_allclose(got, [3., 0.])
