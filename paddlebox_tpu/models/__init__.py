@@ -1,0 +1,2 @@
+from paddlebox_tpu.models.ctr_dnn import CtrDnn  # noqa: F401
+from paddlebox_tpu.models.deepfm import DeepFM  # noqa: F401

@@ -1,0 +1,33 @@
+"""Plain DNN CTR model (the reference's baseline "join" model shape:
+pull_box_sparse → fused_seqpool_cvm → concat dense features → MLP → sigmoid;
+≙ the CTR models in python/paddle/fluid/tests/unittests/dist_fleet_ctr.py and
+BASELINE.md config 1)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import init_mlp, mlp_apply
+
+
+class CtrDnn:
+    """Consumes the fused_seqpool_cvm output [B, S*(3+D)] + dense [B, Dd]."""
+
+    def __init__(self, num_slots: int, emb_width: int, dense_dim: int,
+                 hidden: Sequence[int] = (512, 256, 128)):
+        self.num_slots = num_slots
+        self.emb_width = emb_width   # 3 + mf_dim (show', click', w, embedx)
+        self.dense_dim = dense_dim
+        self.hidden = tuple(hidden)
+
+    def init(self, key):
+        in_dim = self.num_slots * self.emb_width + self.dense_dim
+        return {"mlp": init_mlp(key, (in_dim,) + self.hidden + (1,))}
+
+    def apply(self, params, pooled: jnp.ndarray, dense: jnp.ndarray
+              ) -> jnp.ndarray:
+        x = jnp.concatenate([pooled, dense], axis=-1)
+        return mlp_apply(params["mlp"], x)[:, 0]  # logits [B]

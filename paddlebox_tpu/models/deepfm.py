@@ -1,0 +1,48 @@
+"""DeepFM over pooled slot embeddings (BASELINE.md config 2, PaddleRec
+recipe): first-order = per-slot scalar weights (the pull value's embed_w
+column), second-order = FM interaction over per-slot embedx vectors, deep
+part = MLP over the full pooled output + dense features."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import init_mlp, mlp_apply
+
+
+class DeepFM:
+    def __init__(self, num_slots: int, emb_width: int, dense_dim: int,
+                 hidden: Sequence[int] = (400, 400, 400)):
+        self.num_slots = num_slots
+        self.emb_width = emb_width  # 3 + mf_dim
+        self.mf_dim = emb_width - 3
+        self.dense_dim = dense_dim
+        self.hidden = tuple(hidden)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        in_dim = self.num_slots * self.emb_width + self.dense_dim
+        return {
+            "mlp": init_mlp(k1, (in_dim,) + self.hidden + (1,)),
+            "dense_w": jax.random.uniform(
+                k2, (self.dense_dim, 1), jnp.float32, -0.01, 0.01),
+            "bias": jnp.zeros((1,), jnp.float32),
+        }
+
+    def apply(self, params, pooled: jnp.ndarray, dense: jnp.ndarray
+              ) -> jnp.ndarray:
+        B = pooled.shape[0]
+        per_slot = pooled.reshape(B, self.num_slots, self.emb_width)
+        first = jnp.sum(per_slot[:, :, 2], axis=1, keepdims=True) \
+            + dense @ params["dense_w"]
+        v = per_slot[:, :, 3:]                      # [B, S, D]
+        sum_sq = jnp.sum(v, axis=1) ** 2            # [B, D]
+        sq_sum = jnp.sum(v ** 2, axis=1)
+        second = 0.5 * jnp.sum(sum_sq - sq_sum, axis=1, keepdims=True)
+        deep_in = jnp.concatenate([pooled, dense], axis=-1)
+        deep = mlp_apply(params["mlp"], deep_in)
+        logit = params["bias"] + first + second + deep
+        return logit[:, 0]

@@ -1,0 +1,34 @@
+"""Minimal functional NN layers (dense path runs on the MXU in bf16-friendly
+shapes; no framework dependency so models stay pure pytrees)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp(key, sizes: Sequence[int], scale: str = "xavier"):
+    params = []
+    for i in range(len(sizes) - 1):
+        key, sub = jax.random.split(key)
+        fan_in, fan_out = sizes[i], sizes[i + 1]
+        if scale == "xavier":
+            bound = jnp.sqrt(6.0 / (fan_in + fan_out))
+        else:
+            bound = 1.0 / jnp.sqrt(fan_in)
+        w = jax.random.uniform(sub, (fan_in, fan_out), jnp.float32,
+                               -bound, bound)
+        params.append({"w": w, "b": jnp.zeros((fan_out,), jnp.float32)})
+    return params
+
+
+def mlp_apply(params, x, final_act=None):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+        elif final_act is not None:
+            x = final_act(x)
+    return x

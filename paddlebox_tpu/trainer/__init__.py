@@ -1,0 +1,1 @@
+from paddlebox_tpu.trainer.trainer import SparseTrainer  # noqa: F401

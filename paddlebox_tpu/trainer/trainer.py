@@ -1,0 +1,198 @@
+"""Training loop driver — the train_from_dataset path.
+
+≙ BoxPSTrainer::Run → BoxPSWorker::TrainFiles (boxps_trainer.cc:282,
+boxps_worker.cc:1278): per-batch pack → pull_sparse → ops → push grads →
+dense sync → AUC.  TPU-first structure: the whole per-batch pipeline is ONE
+jitted, donated function (pull gather + fused seqpool/cvm + MLP fwd/bwd +
+scatter-push + sparse optimizer + dense optimizer + AUC bucket update), so
+XLA fuses it and the working set never leaves HBM.  Host threads only pack
+and prefetch batches (≙ PackBatchTask boxps_worker.cc:1259) through a
+bounded Channel.
+
+Dense sync: under a dp-sharded mesh the batch mean IS the global mean, so the
+dense gradient allreduce (≙ BoxWrapper::SyncDense NCCL allreduce,
+boxps_worker.cc:1191) is implicit in GSPMD — no hand-written collective.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from paddlebox_tpu.config import DataFeedConfig, TrainerConfig
+from paddlebox_tpu.data.batch_pack import BatchPacker, PackedBatch
+from paddlebox_tpu.data.dataset import SlotDataset
+from paddlebox_tpu.metrics.auc import (AucCalculator, accumulate_auc,
+                                       make_auc_state)
+from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
+from paddlebox_tpu.parallel.topology import HybridTopology
+from paddlebox_tpu.ps import embedding, optimizer as sparse_opt
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.utils.channel import Channel, ChannelClosed
+from paddlebox_tpu.utils.timer import TimerRegistry
+from paddlebox_tpu import flags
+
+
+class SparseTrainer:
+    def __init__(self, engine: BoxPSEngine, model, feed_config: DataFeedConfig,
+                 batch_size: int, label_slot: str = "label",
+                 dense_optimizer=None, use_cvm: bool = True,
+                 topology: Optional[HybridTopology] = None,
+                 auc_table_size: int = 100_000,
+                 trainer_config: Optional[TrainerConfig] = None,
+                 seed: int = 0):
+        self.engine = engine
+        self.model = model
+        self.packer = BatchPacker(feed_config, batch_size, label_slot)
+        self.batch_size = batch_size
+        self.use_cvm = use_cvm
+        self.topology = topology
+        self.trainer_config = trainer_config or TrainerConfig()
+        self.timers = TimerRegistry()
+        self.slot_ids = np.array(
+            [s.slot_id for s in feed_config.sparse_slots], np.int32)
+
+        self.dense_tx = dense_optimizer or optax.adam(1e-3)
+        self.params = model.init(jax.random.PRNGKey(seed))
+        self.opt_state = self.dense_tx.init(self.params)
+        self.auc_table_size = auc_table_size
+        self.auc_state = make_auc_state(auc_table_size)
+        self.auc = AucCalculator(auc_table_size)
+        self._step_fn = None
+        self._check_nan = flags.get_flags("check_nan_inf")
+
+        if topology is not None:
+            self._batch_sharding = topology.batch_sharding()
+            self._replicated = topology.replicated()
+        else:
+            self._batch_sharding = None
+            self._replicated = None
+
+    # ------------------------------------------------------------------
+    def _build_step(self):
+        sgd_cfg = self.engine.config.sgd
+        use_cvm = self.use_cvm
+        model = self.model
+        dense_tx = self.dense_tx
+        slot_ids = jnp.asarray(self.slot_ids)
+
+        def step(ws, params, opt_state, auc_state, indices, lengths, dense,
+                 labels, valid):
+            # 1. pull (≙ PullSparseCaseGPU box_wrapper_impl.h:25)
+            emb = embedding.pull_sparse(ws, indices)
+            emb = jax.lax.stop_gradient(emb)
+            ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
+
+            # 2-3. forward + backward over (dense params, pulled embeddings)
+            def loss_fn(p, e):
+                pooled = fused_seqpool_cvm(e, lengths, ins_cvm, use_cvm)
+                logits = model.apply(p, pooled, dense)
+                w = valid.astype(jnp.float32)
+                per = optax.sigmoid_binary_cross_entropy(logits, labels)
+                loss = jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
+                return loss, jax.nn.sigmoid(logits)
+
+            (loss, preds), (d_params, d_emb) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(params, emb)
+
+            # 4-6. push + sparse optimizer (≙ PushSparseGradCaseGPU +
+            # SparseAdagrad, box_wrapper_impl.h:373, optimizer.cuh.h:31)
+            acc = embedding.push_sparse_grads(ws, indices, d_emb, slot_ids)
+            ws = sparse_opt.apply_push(ws, acc, sgd_cfg)
+
+            # dense update (≙ SyncDense/async dense table,
+            # boxps_worker.cc:1191-1253 — implicit psum via GSPMD)
+            updates, opt_state = dense_tx.update(d_params, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            # 7. metrics on device (≙ AddAucMonitor boxps_worker.cc:1337)
+            auc_state = accumulate_auc(auc_state, preds, labels, valid)
+            return ws, params, opt_state, auc_state, loss
+
+        donate = (0, 1, 2, 3)
+        self._step_fn = jax.jit(step, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    def _put_batch(self, batch: PackedBatch):
+        arrs = (batch.indices, batch.lengths, batch.dense, batch.labels,
+                batch.valid)
+        if self._batch_sharding is None:
+            return tuple(jnp.asarray(a) for a in arrs)
+        out = []
+        for i, a in enumerate(arrs):
+            if i == 0:  # [S,B,L] — batch dim 1
+                sh = self.topology.sharding(None, ("dp", "sharding"), None)
+            elif i == 1:
+                sh = self.topology.sharding(None, ("dp", "sharding"))
+            else:
+                sh = self._batch_sharding
+            out.append(jax.device_put(a, sh))
+        return tuple(out)
+
+    def train_pass(self, dataset: SlotDataset, prefetch: int = 4
+                   ) -> Dict[str, float]:
+        """Run one full pass over the dataset (≙ TrainFiles loop).
+
+        Packing runs in a background thread feeding a bounded channel so the
+        device step overlaps with host batch assembly.
+        """
+        if self._step_fn is None:
+            self._build_step()
+        engine = self.engine
+        assert engine.ws is not None, "call engine lifecycle first"
+        mapper = engine.mapper
+        ch = Channel(capacity=prefetch)
+
+        def packer_thread():
+            try:
+                for block in dataset.batches(self.batch_size):
+                    with self.timers("pack"):
+                        b = self.packer.pack(block, key_mapper=mapper)
+                    ch.put(b)
+            finally:
+                ch.close()
+
+        t = threading.Thread(target=packer_thread, daemon=True)
+        t.start()
+
+        ws, params = engine.ws, self.params
+        opt_state, auc_state = self.opt_state, self.auc_state
+        n_batches = 0
+        losses = []
+        while True:
+            try:
+                batch = ch.get()
+            except ChannelClosed:
+                break
+            dev = self._put_batch(batch)
+            with self.timers("step"):
+                ws, params, opt_state, auc_state, loss = self._step_fn(
+                    ws, params, opt_state, auc_state, *dev)
+            if self._check_nan and not np.isfinite(float(loss)):
+                raise FloatingPointError(
+                    f"NaN/Inf loss at batch {n_batches}")
+            losses.append(loss)
+            n_batches += 1
+        t.join()
+        engine.ws = ws
+        self.params = params
+        self.opt_state = opt_state
+        self.auc_state = auc_state
+
+        self.auc.reset()
+        self.auc.merge_device_state(jax.device_get(auc_state))
+        out = self.auc.compute()
+        out["batches"] = n_batches
+        out["loss"] = float(np.mean([float(l) for l in losses])) \
+            if losses else float("nan")
+        return out
+
+    def reset_metrics(self):
+        self.auc_state = make_auc_state(self.auc_table_size)
+        self.auc.reset()
