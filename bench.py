@@ -1,0 +1,92 @@
+"""Benchmark: Criteo-shaped sparse-CTR training throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "examples/s", "vs_baseline": N}
+vs_baseline is against the north-star 1M examples/sec/chip (BASELINE.md).
+
+Measures the steady-state full training step (embedding pull gather →
+fused_seqpool_cvm → DeepFM fwd/bwd → scatter push + sparse adagrad → dense
+adam → AUC accumulation) with Criteo geometry: 26 sparse slots × 1 feasign,
+13 dense features, mf_dim=8, on-device pass working set.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.config import (DataFeedConfig, EmbeddingTableConfig,
+                                      SlotConfig, SparseSGDConfig)
+    from paddlebox_tpu.data.batch_pack import PackedBatch
+    from paddlebox_tpu.models.deepfm import DeepFM
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+    from paddlebox_tpu.trainer.trainer import SparseTrainer
+
+    N_SLOTS, DENSE_DIM, MF_DIM, CAP = 26, 13, 8, 1
+    B = 16384
+    N_KEYS = 2_000_000
+    STEPS_WARM, STEPS = 5, 30
+
+    slots = [SlotConfig("label", dtype="float", is_dense=True, dim=1),
+             SlotConfig("dense0", dtype="float", is_dense=True,
+                        dim=DENSE_DIM)]
+    slots += [SlotConfig(f"s{i}", slot_id=100 + i, capacity=CAP)
+              for i in range(N_SLOTS)]
+    cfg = DataFeedConfig(slots=tuple(slots))
+
+    engine = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=MF_DIM, shard_num=8,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    engine.begin_feed_pass()
+    engine.add_keys(np.arange(1, N_KEYS + 1, dtype=np.uint64))
+    engine.end_feed_pass()
+    engine.begin_pass()
+    # mark all mf created so the bench trains full-width embeddings
+    engine.ws["mf_size"] = jnp.full_like(engine.ws["mf_size"], MF_DIM)
+
+    model = DeepFM(num_slots=N_SLOTS, emb_width=3 + MF_DIM,
+                   dense_dim=DENSE_DIM, hidden=(400, 400, 400))
+    trainer = SparseTrainer(engine, model, cfg, batch_size=B,
+                            auc_table_size=100_000)
+    trainer._build_step()
+
+    rng = np.random.default_rng(0)
+    batch = PackedBatch(
+        indices=rng.integers(1, N_KEYS, (N_SLOTS, B, CAP)).astype(np.int32),
+        lengths=np.ones((N_SLOTS, B), np.int32),
+        dense=rng.normal(0, 1, (B, DENSE_DIM)).astype(np.float32),
+        labels=rng.integers(0, 2, (B,)).astype(np.float32),
+        valid=np.ones((B,), bool), num_real=B)
+    dev = trainer._put_batch(batch)
+
+    ws, params = engine.ws, trainer.params
+    opt_state, auc_state = trainer.opt_state, trainer.auc_state
+    for _ in range(STEPS_WARM):
+        ws, params, opt_state, auc_state, loss = trainer._step_fn(
+            ws, params, opt_state, auc_state, *dev)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        ws, params, opt_state, auc_state, loss = trainer._step_fn(
+            ws, params, opt_state, auc_state, *dev)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    eps = B * STEPS / dt
+    print(json.dumps({
+        "metric": "criteo_deepfm_train_examples_per_sec_per_chip",
+        "value": round(eps, 1),
+        "unit": "examples/s",
+        "vs_baseline": round(eps / 1_000_000.0, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
