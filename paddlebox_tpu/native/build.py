@@ -1,0 +1,47 @@
+"""Build the native runtime library (g++ → .so, loaded via ctypes).
+
+≙ the reference's cmake native build for the framework runtime; kept
+dependency-free: compiled on first import into the package dir, with an
+mtime-based rebuild check.  Failures degrade gracefully to the pure-Python
+fallbacks.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["slot_parser.cc", "hash_shard.cc"]
+_LIB = os.path.join(_DIR, "_libpbox_native.so")
+_LOCK = threading.Lock()
+
+
+def lib_path() -> str:
+    return _LIB
+
+
+def ensure_built(quiet: bool = True) -> bool:
+    """Compile if missing/stale. Returns True when the .so is usable."""
+    with _LOCK:
+        srcs = [os.path.join(_DIR, s) for s in _SOURCES
+                if os.path.exists(os.path.join(_DIR, s))]
+        if not srcs:
+            return False
+        if os.path.exists(_LIB):
+            lib_m = os.path.getmtime(_LIB)
+            if all(os.path.getmtime(s) <= lib_m for s in srcs):
+                return True
+        cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+               "-std=c++17", "-o", _LIB] + srcs
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=240)
+            if proc.returncode != 0:
+                if not quiet:
+                    print("native build failed:\n" + proc.stderr)
+                return False
+            return True
+        except Exception:
+            return False
