@@ -1,0 +1,176 @@
+"""Mixture-of-Experts with expert parallelism.
+
+≙ python/paddle/incubate/distributed/models/moe/: MoELayer (moe_layer.py:244)
+with MoEScatter/MoEGather over global_scatter/global_gather all2all ops
+(:88-151), and the gate zoo (models/moe/gate/): naive, switch (top-1),
+gshard (top-2 + aux load-balance loss).
+
+TPU-first formulation: the einsum dispatch/combine form — tokens one-hot
+into [E, C] capacity buckets, ``lax.all_to_all`` over the ``ep`` axis moves
+expert shards (exactly the reference's global_scatter), experts run batched
+matmuls on [E_local, n*C, d] (MXU-friendly), then the inverse path.  No
+sorting, no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# -- gates (≙ models/moe/gate/{naive,switch,gshard}_gate.py) ---------------
+
+def top1_gate(logits: jnp.ndarray, capacity: int):
+    """Switch-style top-1 routing → (dispatch [T,E,C], combine [T,E,C],
+    aux_loss).  T = local tokens, E = global experts."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, -1)
+    expert = jnp.argmax(probs, -1)                       # [T]
+    onehot = jax.nn.one_hot(expert, E, dtype=probs.dtype)
+    # position of each token within its expert's capacity bucket
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0      # [T,E]
+    keep = (pos >= 0) & (pos < capacity)
+    pos_c = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    dispatch = (jax.nn.one_hot(pos_c, capacity, dtype=probs.dtype)
+                * keep[..., None] * onehot[..., None])   # [T,E,C]
+    gate_val = jnp.sum(probs * onehot, -1)               # [T]
+    combine = dispatch * gate_val[:, None, None]
+    # switch aux loss: E * sum(fraction_tokens * fraction_probs)
+    me = jnp.mean(onehot, axis=0)
+    ce = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(me * ce)
+    return dispatch, combine, aux
+
+
+def top2_gate(logits: jnp.ndarray, capacity: int):
+    """GShard top-2 gate (second expert weighted, shared capacity)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, -1)
+    e1 = jnp.argmax(probs, -1)
+    oh1 = jax.nn.one_hot(e1, E, dtype=probs.dtype)
+    probs2 = probs * (1 - oh1)
+    e2 = jnp.argmax(probs2, -1)
+    oh2 = jax.nn.one_hot(e2, E, dtype=probs.dtype)
+    g1 = jnp.sum(probs * oh1, -1)
+    g2 = jnp.sum(probs * oh2, -1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    pos1 = jnp.cumsum(oh1, 0) * oh1 - 1.0
+    # second choices queue behind all first choices of the same expert
+    pos2 = (jnp.cumsum(oh2, 0) + jnp.sum(oh1, 0, keepdims=True)) * oh2 - 1.0
+
+    def build(oh, pos, gate_val):
+        keep = (pos >= 0) & (pos < capacity)
+        pc = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+        d = (jax.nn.one_hot(pc, capacity, dtype=probs.dtype)
+             * keep[..., None] * oh[..., None])
+        return d, d * gate_val[:, None, None]
+
+    d1, c1 = build(oh1, pos1, g1)
+    d2, c2 = build(oh2, pos2, g2)
+    me = jnp.mean(oh1, 0)
+    ce = jnp.mean(probs, 0)
+    aux = E * jnp.sum(me * ce)
+    return d1 + d2, c1 + c2, aux
+
+
+GATES = {"switch": top1_gate, "gshard": top2_gate, "naive": top1_gate}
+
+
+# -- expert-parallel layer --------------------------------------------------
+
+@dataclasses.dataclass
+class MoEConfig:
+    d_model: int
+    d_hidden: int
+    num_experts: int          # global expert count (divisible by ep size)
+    capacity_factor: float = 1.25
+    gate: str = "gshard"
+
+
+class MoELayer:
+    """Call apply_sharded inside shard_map with tokens sharded over `ep`.
+
+    params["experts"]: w1 [E, d, h], b1 [E, h], w2 [E, h, d], b2 [E, d] —
+    expert dim sharded over ep; params["gate"]: [d, E] replicated.
+    """
+
+    def __init__(self, config: MoEConfig, axis: str = "ep"):
+        self.cfg = config
+        self.axis = axis
+
+    def init(self, key) -> Dict:
+        c = self.cfg
+        k1, k2, k3 = jax.random.split(key, 3)
+        s1 = (6.0 / (c.d_model + c.d_hidden)) ** 0.5
+        return {
+            "gate": jax.random.normal(k3, (c.d_model, c.num_experts),
+                                      jnp.float32) * 0.02,
+            "w1": jax.random.uniform(k1, (c.num_experts, c.d_model,
+                                          c.d_hidden), jnp.float32, -s1, s1),
+            "b1": jnp.zeros((c.num_experts, c.d_hidden), jnp.float32),
+            "w2": jax.random.uniform(k2, (c.num_experts, c.d_hidden,
+                                          c.d_model), jnp.float32, -s1, s1),
+            "b2": jnp.zeros((c.num_experts, c.d_model), jnp.float32),
+        }
+
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+        ax = self.axis
+        return {"gate": P(), "w1": P(ax), "b1": P(ax),
+                "w2": P(ax), "b2": P(ax)}
+
+    def capacity(self, tokens_local: int, ep: int) -> int:
+        c = self.cfg
+        cap = int(self.cfg.capacity_factor * tokens_local * ep
+                  / c.num_experts)
+        return max(cap, 4)
+
+    def apply_sharded(self, params_local, x, ep: int
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """x: [T_local, d].  params_local experts: [E/ep, ...].  Returns
+        (y [T_local, d], aux_loss)."""
+        c = self.cfg
+        T, d = x.shape
+        E = c.num_experts
+        cap = self.capacity(T, ep)
+        logits = x @ params_local["gate"]
+        dispatch, combine, aux = GATES[c.gate](logits, cap)
+        # local buckets per global expert [E, C, d]
+        buckets = jnp.einsum("td,tec->ecd", x, dispatch)
+        # ≙ global_scatter: all_to_all so each device holds its experts'
+        # buckets from every peer: [E,C,d] → [E/ep, ep*C, d]
+        # (global expert id = owner_device * e_loc + local_expert)
+        e_loc = E // ep
+        buckets = lax.all_to_all(buckets, self.axis, split_axis=0,
+                                 concat_axis=1, tiled=True)
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", buckets,
+                                   params_local["w1"])
+                        + params_local["b1"][:, None, :])
+        out = jnp.einsum("ech,ehd->ecd", h, params_local["w2"]) \
+            + params_local["b2"][:, None, :]
+        # ≙ global_gather: inverse all_to_all back to source devices
+        out = lax.all_to_all(out, self.axis, split_axis=1, concat_axis=0,
+                             tiled=True)  # [E, cap, d]
+        y = jnp.einsum("ecd,tec->td", out, combine)
+        return y, aux
+
+    def apply_dense(self, params, x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Unsharded golden path (all experts local) for tests."""
+        c = self.cfg
+        T, d = x.shape
+        cap = self.capacity(T, 1)
+        logits = x @ params["gate"]
+        dispatch, combine, aux = GATES[c.gate](logits, cap)
+        buckets = jnp.einsum("td,tec->ecd", x, dispatch)
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", buckets, params["w1"])
+                        + params["b1"][:, None, :])
+        out = jnp.einsum("ech,ehd->ecd", h, params["w2"]) \
+            + params["b2"][:, None, :]
+        y = jnp.einsum("ecd,tec->td", out, combine)
+        return y, aux

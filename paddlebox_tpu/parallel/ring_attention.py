@@ -1,0 +1,94 @@
+"""Ring attention — context parallelism over the sequence axis.
+
+The reference has NO sequence/context parallelism (SURVEY.md §2.7: grep
+verified absent) — this is a TPU-first addition designed to the same overlap
+budget as HeterComm's shard-walk (§3.3): K/V blocks rotate around the mesh
+axis via ``lax.ppermute`` (ICI neighbor hops) while each device accumulates
+its queries' attention with a numerically-stable online softmax (flash-style
+m/l running stats), so peak memory is O(T_local²) and comm overlaps compute.
+
+Use inside shard_map with q/k/v sequence-sharded: [B, T/n, H, Dh] per device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attend(q, k, v, bias):
+    # q [B,Tq,H,D], k/v [B,Tk,H,D] → scores [B,H,Tq,Tk]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    if bias is not None:
+        scores = scores + bias
+    return scores
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis: str, axis_size: int, causal: bool = False,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Per-device blockwise attention with rotating K/V (call in shard_map).
+
+    q, k, v: [B, T_local, H, Dh]; returns [B, T_local, H, Dh].
+    """
+    B, Tl, H, Dh = q.shape
+    if scale is None:
+        scale = Dh ** -0.5
+    q = q * scale
+    my = lax.axis_index(axis)
+    # positions of my queries (global)
+    q_pos = my * Tl + jnp.arange(Tl)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, step_idx):
+        k_blk, v_blk, m, l, acc = carry
+        # the block currently held started at device (my - step) mod n
+        src = (my - step_idx) % axis_size
+        scores = _block_attend(q, k_blk, v_blk, None)  # [B,H,Tq,Tk]
+        if causal:
+            k_pos = src * Tl + jnp.arange(Tl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        blk_max = jnp.max(scores, axis=-1)              # [B,H,Tq]
+        new_m = jnp.maximum(m, blk_max)
+        # guard fully-masked blocks (−inf max)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        correction = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+        correction = jnp.where(jnp.isfinite(m), correction, 0.0)
+        l = l * correction + jnp.sum(p, -1)
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk)
+        # rotate K/V to the next device
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        return (k_blk, v_blk, new_m, l, acc), None
+
+    m0 = jnp.full((B, H, Tl), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((B, H, Tl), q.dtype)
+    acc0 = jnp.zeros((B, H, Tl, Dh), q.dtype)
+    (k, v, m, l, acc), _ = lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(axis_size))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]        # [B,H,Tq,Dh]
+    return jnp.transpose(out, (0, 2, 1, 3))             # [B,Tq,H,Dh]
+
+
+def reference_attention(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Unsharded golden attention for tests."""
+    B, T, H, Dh = q.shape
+    if scale is None:
+        scale = Dh ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    return jnp.transpose(out, (0, 2, 1, 3))

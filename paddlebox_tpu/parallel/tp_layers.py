@@ -1,0 +1,160 @@
+"""Tensor-parallel layers.
+
+≙ fleet/meta_parallel/parallel_layers/mp_layers.py — VocabParallelEmbedding
+(:30), ColumnParallelLinear (:95), RowParallelLinear (:171),
+ParallelCrossEntropy (:251).
+
+TPU-first: layers are functional (init/apply) and come in two flavors that
+share parameters:
+* GSPMD flavor: ``param_specs()`` gives PartitionSpecs; apply() is plain
+  dense math + ``with_sharding_constraint`` — XLA inserts the collectives
+  the reference hand-writes (identity fwd/allreduce bwd etc.).
+* shard_map flavor (``apply_sharded``): explicit per-device math with
+  psum/all_gather, for use inside shard_map regions (and as the executable
+  spec of what GSPMD should do).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+class ColumnParallelLinear:
+    """Weight [in, out] split on out (≙ mp_layers.py:95: identity fwd,
+    allreduce grad; optional gather of the column-sharded output)."""
+
+    def __init__(self, in_dim: int, out_dim: int, use_bias: bool = True,
+                 gather_output: bool = True, axis: str = "mp"):
+        self.in_dim, self.out_dim = in_dim, out_dim
+        self.use_bias = use_bias
+        self.gather_output = gather_output
+        self.axis = axis
+
+    def init(self, key) -> Dict:
+        bound = jnp.sqrt(6.0 / (self.in_dim + self.out_dim))
+        w = jax.random.uniform(key, (self.in_dim, self.out_dim), jnp.float32,
+                               -bound, bound)
+        p = {"w": w}
+        if self.use_bias:
+            p["b"] = jnp.zeros((self.out_dim,), jnp.float32)
+        return p
+
+    def param_specs(self) -> Dict:
+        spec = {"w": P(None, self.axis)}
+        if self.use_bias:
+            spec["b"] = P(self.axis)
+        return spec
+
+    def apply(self, params, x):
+        y = x @ params["w"]
+        if self.use_bias:
+            y = y + params["b"]
+        return y
+
+    def apply_sharded(self, params_local, x):
+        """Inside shard_map: params_local is the [in, out/mp] shard; x is
+        replicated along mp. → local [B, out/mp] (gather if configured)."""
+        y = x @ params_local["w"]
+        if self.use_bias:
+            y = y + params_local["b"]
+        if self.gather_output:
+            y = lax.all_gather(y, self.axis, axis=y.ndim - 1, tiled=True)
+        return y
+
+
+class RowParallelLinear:
+    """Weight [in, out] split on in; partial products psum-reduced
+    (≙ mp_layers.py:171)."""
+
+    def __init__(self, in_dim: int, out_dim: int, use_bias: bool = True,
+                 input_is_parallel: bool = False, axis: str = "mp"):
+        self.in_dim, self.out_dim = in_dim, out_dim
+        self.use_bias = use_bias
+        self.input_is_parallel = input_is_parallel
+        self.axis = axis
+
+    def init(self, key) -> Dict:
+        bound = jnp.sqrt(6.0 / (self.in_dim + self.out_dim))
+        w = jax.random.uniform(key, (self.in_dim, self.out_dim), jnp.float32,
+                               -bound, bound)
+        p = {"w": w}
+        if self.use_bias:
+            p["b"] = jnp.zeros((self.out_dim,), jnp.float32)
+        return p
+
+    def param_specs(self) -> Dict:
+        spec = {"w": P(self.axis, None)}
+        if self.use_bias:
+            spec["b"] = P()  # bias added once after the reduce
+        return spec
+
+    def apply(self, params, x):
+        y = x @ params["w"]
+        if self.use_bias:
+            y = y + params["b"]
+        return y
+
+    def apply_sharded(self, params_local, x):
+        if not self.input_is_parallel:
+            # split replicated input along features to match the row shard
+            idx = lax.axis_index(self.axis)
+            shard = params_local["w"].shape[0]
+            x = lax.dynamic_slice_in_dim(x, idx * shard, shard, x.ndim - 1)
+        y = lax.psum(x @ params_local["w"], self.axis)
+        if self.use_bias:
+            y = y + params_local["b"]
+        return y
+
+
+class VocabParallelEmbedding:
+    """Embedding [vocab, dim] row-split over mp; out-of-shard rows contribute
+    zeros, psum combines (≙ mp_layers.py:30-92 mask + allreduce)."""
+
+    def __init__(self, vocab: int, dim: int, axis: str = "mp"):
+        assert vocab > 0 and dim > 0
+        self.vocab, self.dim = vocab, dim
+        self.axis = axis
+
+    def init(self, key) -> Dict:
+        return {"w": jax.random.normal(key, (self.vocab, self.dim),
+                                       jnp.float32) * 0.02}
+
+    def param_specs(self) -> Dict:
+        return {"w": P(self.axis, None)}
+
+    def apply(self, params, ids):
+        return params["w"][ids]
+
+    def apply_sharded(self, params_local, ids):
+        shard = params_local["w"].shape[0]
+        start = lax.axis_index(self.axis) * shard
+        local = ids - start
+        in_range = (local >= 0) & (local < shard)
+        local = jnp.clip(local, 0, shard - 1)
+        emb = params_local["w"][local] * in_range[..., None]
+        return lax.psum(emb, self.axis)
+
+
+def parallel_cross_entropy(logits_local: jnp.ndarray, labels: jnp.ndarray,
+                           axis: str = "mp") -> jnp.ndarray:
+    """Softmax CE over class-sharded logits without materializing the full
+    row (≙ ParallelCrossEntropy mp_layers.py:251 / c_softmax_with_
+    cross_entropy_op): max/sum-exp/target-logit each combined by collectives.
+    Use inside shard_map with logits split on the last dim."""
+    n_local = logits_local.shape[-1]
+    start = lax.axis_index(axis) * n_local
+    gmax = lax.pmax(jnp.max(logits_local, -1), axis)
+    z = jnp.exp(logits_local - gmax[..., None])
+    denom = lax.psum(jnp.sum(z, -1), axis)
+    local_label = labels - start
+    in_range = (local_label >= 0) & (local_label < n_local)
+    picked = jnp.take_along_axis(
+        logits_local, jnp.clip(local_label, 0, n_local - 1)[..., None],
+        axis=-1)[..., 0]
+    target = lax.psum(jnp.where(in_range, picked, 0.0), axis)
+    return jnp.log(denom) + gmax - target

@@ -1,0 +1,47 @@
+"""Ulysses-style sequence parallelism: all_to_all head↔sequence reshard.
+
+Absent from the reference (SURVEY.md §2.7) — TPU-first addition.  With the
+sequence sharded over the ``sp`` axis, attention needs full sequence per
+head; instead of gathering T, all_to_all swaps the sharded dimension:
+[B, T/n, H, D] → [B, T, H/n, D], local full-sequence attention per head
+subset, then the inverse all_to_all — two ICI all-to-alls instead of an
+all-gather of activations (the MoE global_scatter/global_gather trick,
+moe_layer.py:88-151, applied to attention heads).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def seq_to_heads(x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """[B, T_local, H, D] → [B, T_global, H_local, D] (inside shard_map)."""
+    return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def heads_to_seq(x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """[B, T_global, H_local, D] → [B, T_local, H, D] (inside shard_map)."""
+    return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis: str,
+                      attn_fn: Optional[Callable] = None,
+                      causal: bool = False) -> jnp.ndarray:
+    """Sequence-parallel attention via head resharding (call in shard_map).
+
+    q/k/v: [B, T_local, H, Dh] sequence-sharded on `axis`; H must be
+    divisible by the axis size.  attn_fn defaults to the dense golden
+    attention (ring_attention.reference_attention).
+    """
+    from paddlebox_tpu.parallel.ring_attention import reference_attention
+    if attn_fn is None:
+        attn_fn = lambda q, k, v: reference_attention(q, k, v, causal=causal)
+    q = seq_to_heads(q, axis)
+    k = seq_to_heads(k, axis)
+    v = seq_to_heads(v, axis)
+    out = attn_fn(q, k, v)           # [B, T_global, H_local, Dh]
+    return heads_to_seq(out, axis)   # [B, T_local, H, Dh]
