@@ -1,0 +1,176 @@
+"""Fleet facade — the user-level API surface.
+
+≙ paddle.distributed.fleet (fleet/base/fleet_base.py:144: init :211,
+distributed_optimizer :912, minimize :1477), the BoxPSDataset python class
+(python/paddle/fluid/dataset.py:1231: set_date/begin_pass/end_pass/
+load_into_memory/preload_into_memory/wait_preload_done/slots_shuffle) and
+Executor.train_from_dataset (executor.py:2412).
+
+A reference user drives training as:
+    fleet.init(strategy)
+    dataset = fleet.DatasetFactory().create_dataset("BoxPSDataset")
+    dataset.set_use_var(...); dataset.set_filelist(...)
+    dataset.set_date(d); dataset.load_into_memory(); dataset.begin_pass()
+    exe.train_from_dataset(program, dataset)
+    dataset.end_pass(True)
+This module offers the same verbs over the TPU engine/trainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.config import (DataFeedConfig, DistributedStrategy,
+                                  EmbeddingTableConfig, MeshConfig,
+                                  TrainerConfig)
+from paddlebox_tpu.data.dataset import SlotDataset, ShuffleTransport
+from paddlebox_tpu.metrics.auc import MetricGroup
+from paddlebox_tpu.parallel.topology import HybridTopology
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.trainer.trainer import SparseTrainer
+
+_GLOBAL: Dict = {"fleet": None}
+
+
+class Fleet:
+    """Process-wide runtime handle (≙ fleet_base.Fleet singleton)."""
+
+    def __init__(self, strategy: Optional[DistributedStrategy] = None,
+                 topology: Optional[HybridTopology] = None):
+        self.strategy = strategy or DistributedStrategy()
+        self.topology = topology
+        self.engine: Optional[BoxPSEngine] = None
+        self.metrics = MetricGroup()
+
+    # ≙ fleet.init(is_collective/role_maker)
+    def init_engine(self, table_config: Optional[EmbeddingTableConfig] = None,
+                    seed: int = 0) -> BoxPSEngine:
+        self.engine = BoxPSEngine(table_config or self.strategy.table,
+                                  topology=self.topology, seed=seed)
+        return self.engine
+
+    @property
+    def worker_num(self) -> int:
+        return 1 if self.topology is None else self.topology.world_size
+
+    def barrier_worker(self) -> None:
+        pass  # single-host; multi-host via jax.distributed in launch.py
+
+
+def init(strategy: Optional[DistributedStrategy] = None,
+         topology: Optional[HybridTopology] = None) -> Fleet:
+    f = Fleet(strategy, topology)
+    _GLOBAL["fleet"] = f
+    return f
+
+
+def instance() -> Fleet:
+    if _GLOBAL["fleet"] is None:
+        init()
+    return _GLOBAL["fleet"]
+
+
+class BoxPSDataset:
+    """≙ BoxPSDataset (dataset.py:1231) + the BoxHelper pass driver: one
+    object owning the slot dataset AND driving the engine's feed-pass
+    overlap, so user code reads like the reference's day/pass loop."""
+
+    def __init__(self, feed_config: DataFeedConfig,
+                 engine: Optional[BoxPSEngine] = None,
+                 parse_ins_id: bool = False, parse_logkey: bool = False,
+                 read_threads: int = 4,
+                 transport: Optional[ShuffleTransport] = None):
+        self.feed_config = feed_config
+        self.engine = engine or instance().engine
+        assert self.engine is not None, "fleet.init_engine() first"
+        self.dataset = SlotDataset(feed_config, parse_ins_id, parse_logkey,
+                                   read_threads, transport)
+        self.engine.attach_dataset(self.dataset)
+
+    # -- file/date plumbing (dataset.py:1252-1285) --------------------------
+    def set_filelist(self, filelist: Sequence[str]) -> None:
+        self.dataset.set_filelist(filelist)
+
+    def set_date(self, date: str) -> None:
+        self.engine.set_date(date)
+
+    # -- pass lifecycle ------------------------------------------------------
+    def load_into_memory(self) -> None:
+        self.engine.begin_feed_pass()
+        self.dataset.load_into_memory()
+
+    def preload_into_memory(self) -> None:
+        self.engine.begin_feed_pass()
+        self.dataset.preload_into_memory()
+
+    def wait_preload_done(self) -> None:
+        self.dataset.wait_preload_done()
+
+    def begin_pass(self) -> None:
+        self.engine.end_feed_pass()
+        self.engine.begin_pass()
+
+    def end_pass(self, need_save_delta: bool = False,
+                 delta_path: str = "") -> None:
+        self.engine.end_pass(need_save_delta, delta_path)
+        self.dataset.release_memory()
+
+    # -- shuffles ------------------------------------------------------------
+    def local_shuffle(self) -> None:
+        self.dataset.local_shuffle()
+
+    def global_shuffle(self, by_ins_id: bool = False) -> None:
+        self.dataset.global_shuffle(by_ins_id)
+
+    def slots_shuffle(self, slots: Sequence[str]) -> None:
+        """≙ BoxPSDataset.slots_shuffle (dataset.py:1302 →
+        SlotsShuffle box_wrapper.h:1186): permute the chosen slots' feasign
+        spans across instances, keeping everything else fixed (feature
+        importance ablation)."""
+        import numpy as _np
+        rng = _np.random.default_rng(0)
+        for block in self.dataset.get_blocks():
+            for name in slots:
+                if name not in block.uint64_slots:
+                    continue
+                values, offsets = block.uint64_slots[name]
+                lens = _np.diff(offsets)
+                order = rng.permutation(block.n)
+                # records keep their own length; only spans with equal length
+                # swap cleanly — group by length and permute within groups
+                for length in _np.unique(lens):
+                    rows = _np.nonzero(lens == length)[0]
+                    if len(rows) < 2 or length == 0:
+                        continue
+                    perm = rows[rng.permutation(len(rows))]
+                    spans = _np.stack([
+                        values[offsets[r]:offsets[r] + length]
+                        for r in perm])
+                    for i, r in enumerate(rows):
+                        values[offsets[r]:offsets[r] + length] = spans[i]
+
+    # -- stats ---------------------------------------------------------------
+    def get_memory_data_size(self) -> int:
+        return self.dataset.instance_num()
+
+    def get_shuffle_data_size(self) -> int:
+        return self.dataset.instance_num()
+
+
+class DatasetFactory:
+    """≙ fluid.DatasetFactory (dataset.py:31)."""
+
+    def create_dataset(self, name: str = "BoxPSDataset", **kw) -> BoxPSDataset:
+        if name in ("BoxPSDataset", "InMemoryDataset", "SlotRecordDataset"):
+            return BoxPSDataset(**kw)
+        raise ValueError(f"unknown dataset type {name}")
+
+
+def train_from_dataset(trainer: SparseTrainer, dataset: BoxPSDataset,
+                       ) -> Dict[str, float]:
+    """≙ Executor.train_from_dataset (executor.py:2412 →
+    BoxPSTrainer::Run)."""
+    return trainer.train_pass(dataset.dataset)

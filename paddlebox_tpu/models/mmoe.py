@@ -1,0 +1,56 @@
+"""MMoE multi-task recommender (BASELINE.md config 4: shared embedding +
+expert mixture + per-task gates/towers).  apply() returns task-0 logits for
+the single-label trainer; apply_multi() returns [B, num_tasks] for the
+multi-task trainer path."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import init_mlp, mlp_apply
+
+
+class MMoE:
+    def __init__(self, num_slots: int, emb_width: int, dense_dim: int,
+                 num_experts: int = 4, num_tasks: int = 2,
+                 expert_hidden: Sequence[int] = (64,),
+                 tower_hidden: Sequence[int] = (32,)):
+        self.num_slots = num_slots
+        self.emb_width = emb_width
+        self.dense_dim = dense_dim
+        self.num_experts = num_experts
+        self.num_tasks = num_tasks
+        self.expert_hidden = tuple(expert_hidden)
+        self.tower_hidden = tuple(tower_hidden)
+
+    def init(self, key):
+        in_dim = self.num_slots * self.emb_width + self.dense_dim
+        keys = jax.random.split(key, self.num_experts + 2 * self.num_tasks)
+        experts = [init_mlp(keys[i], (in_dim,) + self.expert_hidden)
+                   for i in range(self.num_experts)]
+        gates = [jax.random.normal(keys[self.num_experts + t],
+                                   (in_dim, self.num_experts)) * 0.02
+                 for t in range(self.num_tasks)]
+        towers = [init_mlp(keys[self.num_experts + self.num_tasks + t],
+                           (self.expert_hidden[-1],) + self.tower_hidden
+                           + (1,))
+                  for t in range(self.num_tasks)]
+        return {"experts": experts, "gates": gates, "towers": towers}
+
+    def apply_multi(self, params, pooled, dense):
+        x = jnp.concatenate([pooled, dense], axis=-1)
+        expert_out = jnp.stack(
+            [jax.nn.relu(mlp_apply(e, x)) for e in params["experts"]],
+            axis=1)  # [B, E, H]
+        logits = []
+        for t in range(self.num_tasks):
+            g = jax.nn.softmax(x @ params["gates"][t], axis=-1)  # [B, E]
+            mixed = jnp.einsum("be,beh->bh", g, expert_out)
+            logits.append(mlp_apply(params["towers"][t], mixed)[:, 0])
+        return jnp.stack(logits, axis=1)  # [B, T]
+
+    def apply(self, params, pooled, dense):
+        return self.apply_multi(params, pooled, dense)[:, 0]

@@ -1,0 +1,39 @@
+"""Wide&Deep with fused_seqpool_cvm sequence features (BASELINE.md config 3).
+
+Wide: sparse linear over the pooled slot outputs (the CVM-transformed
+show/click cols + per-slot embed_w act as the wide crossed features) plus
+dense features; Deep: MLP over pooled embeddings + dense."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import init_mlp, mlp_apply
+
+
+class WideDeep:
+    def __init__(self, num_slots: int, emb_width: int, dense_dim: int,
+                 hidden: Sequence[int] = (256, 128, 64)):
+        self.num_slots = num_slots
+        self.emb_width = emb_width
+        self.dense_dim = dense_dim
+        self.hidden = tuple(hidden)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        in_dim = self.num_slots * self.emb_width + self.dense_dim
+        return {
+            "mlp": init_mlp(k1, (in_dim,) + self.hidden + (1,)),
+            "wide_w": jax.random.uniform(k2, (in_dim, 1), jnp.float32,
+                                         -0.01, 0.01),
+            "wide_b": jnp.zeros((1,), jnp.float32),
+        }
+
+    def apply(self, params, pooled, dense):
+        x = jnp.concatenate([pooled, dense], axis=-1)
+        wide = x @ params["wide_w"] + params["wide_b"]
+        deep = mlp_apply(params["mlp"], x)
+        return (wide + deep)[:, 0]
