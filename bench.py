@@ -68,13 +68,13 @@ def main():
     ws, params = engine.ws, trainer.params
     opt_state, auc_state = trainer.opt_state, trainer.auc_state
     for _ in range(STEPS_WARM):
-        ws, params, opt_state, auc_state, loss = trainer._step_fn(
+        ws, params, opt_state, auc_state, loss, _p = trainer._step_fn(
             ws, params, opt_state, auc_state, *dev)
     jax.block_until_ready(loss)
 
     t0 = time.perf_counter()
     for _ in range(STEPS):
-        ws, params, opt_state, auc_state, loss = trainer._step_fn(
+        ws, params, opt_state, auc_state, loss, _p = trainer._step_fn(
             ws, params, opt_state, auc_state, *dev)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0

@@ -35,6 +35,7 @@ class PackedBatch:
     valid: np.ndarray         # [B] bool — false for padded records
     num_real: int             # records before padding
     keys: Optional[np.ndarray] = None   # [S, B, L] uint64 raw feasigns
+    ins_ids: Optional[list] = None      # [num_real] instance ids (for dump)
 
 
 class BatchPacker:
@@ -113,4 +114,5 @@ class BatchPacker:
             indices = np.zeros((S, B, L), dtype=np.int32)
 
         return PackedBatch(indices=indices, lengths=lengths, dense=dense,
-                           labels=labels, valid=valid, num_real=n, keys=keys)
+                           labels=labels, valid=valid, num_real=n, keys=keys,
+                           ins_ids=block.ins_ids)

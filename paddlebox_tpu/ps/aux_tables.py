@@ -1,0 +1,98 @@
+"""Auxiliary tables: replica cache + string-keyed input table.
+
+≙ GpuReplicaCache (box_wrapper.h:63-122 + PullCacheValue box_wrapper.cu:1210)
+— a small dense table fully replicated in every device's HBM, pulled by row
+index; and InputTable (box_wrapper.h:124-197, ops lookup_input,
+InputTableDataFeed data_feed.h:2224) — a host-side string→index dictionary
+assigning stable ids used as replica-cache rows.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class ReplicaCache:
+    """Host-accumulated dense rows, replicated to device; gather by index.
+
+    Row 0 is reserved as the zero/miss row (same convention as the sparse
+    working set)."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+        self._rows: List[np.ndarray] = [np.zeros((dim,), np.float32)]
+        self._device: Optional[jnp.ndarray] = None
+        self._lock = threading.Lock()
+
+    def add_item(self, vec: np.ndarray) -> int:
+        with self._lock:
+            self._rows.append(np.asarray(vec, np.float32).reshape(self.dim))
+            self._device = None
+            return len(self._rows) - 1
+
+    def add_items(self, mat: np.ndarray) -> np.ndarray:
+        with self._lock:
+            start = len(self._rows)
+            for r in np.asarray(mat, np.float32).reshape(-1, self.dim):
+                self._rows.append(r)
+            self._device = None
+            return np.arange(start, len(self._rows))
+
+    def to_device(self, sharding=None) -> jnp.ndarray:
+        """Replicate to HBM (≙ h2d copy in InitializeGPUAndLoadModel)."""
+        with self._lock:
+            if self._device is None:
+                host = np.stack(self._rows)
+                self._device = (jax.device_put(host, sharding)
+                                if sharding is not None else
+                                jnp.asarray(host))
+            return self._device
+
+    @staticmethod
+    def pull(table: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+        """jit-able gather (≙ PullCacheValue kernel)."""
+        return table[indices]
+
+    def __len__(self):
+        return len(self._rows)
+
+
+class InputTable:
+    """String → stable index (≙ InputTable box_wrapper.h:124; the index is
+    then used against a ReplicaCache or dense var)."""
+
+    def __init__(self):
+        self._map: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def get_or_insert(self, key: str) -> int:
+        with self._lock:
+            idx = self._map.get(key)
+            if idx is None:
+                idx = len(self._map) + 1  # 0 = miss
+                self._map[key] = idx
+            return idx
+
+    def lookup(self, keys: Sequence[str]) -> np.ndarray:
+        with self._lock:
+            return np.array([self._map.get(k, 0) for k in keys], np.int32)
+
+    def __len__(self):
+        return len(self._map)
+
+    def save(self, path: str) -> None:
+        with self._lock, open(path, "w") as f:
+            for k, v in self._map.items():
+                f.write(f"{k}\t{v}\n")
+
+    def load(self, path: str) -> None:
+        with self._lock, open(path) as f:
+            self._map = {}
+            for line in f:
+                k, v = line.rstrip("\n").split("\t")
+                self._map[k] = int(v)

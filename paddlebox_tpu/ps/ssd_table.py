@@ -1,0 +1,198 @@
+"""SSD tier below the DRAM host table.
+
+≙ SSDSparseTable (ps/table/ssd_sparse_table.{h,cc}): cold features live on
+disk (the reference embeds RocksDB, ssd_sparse_table.h:81), hot ones stay in
+DRAM; a cache threshold decides promotion, Save/SaveCache/Shrink traverse
+both tiers.
+
+TPU-first simplification (no RocksDB in the image): an append-only
+log-structured store per shard — fixed-width binary rows in a data file plus
+an in-memory key→offset index (rebuilt from the file on open).  Point reads
+are one pread; pass-batched reads are sorted-offset sequential scans.
+Compaction rewrites live rows (≙ rocksdb compaction, triggered by Shrink).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.ps import feature_value as fv
+
+_MAGIC = b"PBOXSSD1"
+
+
+class SSDShard:
+    """One shard's log file: rows of (key u64 | field payload f32[width])."""
+
+    def __init__(self, path: str, mf_dim: int):
+        self.path = path
+        self.mf_dim = mf_dim
+        # payload field order mirrors feature_value.HOST_FIELDS
+        self.scalar_fields = [f for f, _, s in fv.HOST_FIELDS if s == ()]
+        self.width = len(self.scalar_fields) + mf_dim
+        self.row_bytes = 8 + 4 * self.width
+        self.index: Dict[int, int] = {}   # key → byte offset of latest row
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if os.path.exists(path):
+            self._rebuild_index()
+        else:
+            with open(path, "wb") as f:
+                f.write(_MAGIC)
+
+    def _rebuild_index(self) -> None:
+        size = os.path.getsize(self.path)
+        with open(self.path, "rb") as f:
+            assert f.read(8) == _MAGIC, "corrupt ssd shard file"
+            off = 8
+            while off + self.row_bytes <= size:
+                key = struct.unpack("<Q", f.read(8))[0]
+                f.seek(4 * self.width, 1)
+                self.index[key] = off
+                off += self.row_bytes
+
+    def _encode(self, soa: Dict[str, np.ndarray], i: int) -> bytes:
+        scalars = np.array([soa[f][i] for f in self.scalar_fields],
+                           np.float32)
+        return scalars.tobytes() + soa["mf"][i].astype(np.float32).tobytes()
+
+    def _decode(self, payload: bytes) -> Dict[str, np.ndarray]:
+        arr = np.frombuffer(payload, np.float32)
+        out = {}
+        for j, f in enumerate(self.scalar_fields):
+            out[f] = arr[j]
+        out["mf"] = arr[len(self.scalar_fields):].copy()
+        return out
+
+    def write_rows(self, keys: np.ndarray, soa: Dict[str, np.ndarray]) -> None:
+        with self._lock, open(self.path, "ab") as f:
+            for i, k in enumerate(keys):
+                off = f.tell()
+                f.write(struct.pack("<Q", int(k)))
+                f.write(self._encode(soa, i))
+                self.index[int(k)] = off
+
+    def read_rows(self, keys: np.ndarray
+                  ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+        """→ (soa rows aligned to keys, found mask); missing rows zeroed."""
+        n = len(keys)
+        soa = fv.empty_soa(n, self.mf_dim)
+        found = np.zeros(n, bool)
+        order = sorted(range(n),
+                       key=lambda i: self.index.get(int(keys[i]), -1))
+        with self._lock, open(self.path, "rb") as f:
+            for i in order:
+                off = self.index.get(int(keys[i]))
+                if off is None:
+                    continue
+                f.seek(off + 8)
+                row = self._decode(f.read(4 * self.width))
+                for name, v in row.items():
+                    soa[name][i] = v
+                found[i] = True
+        return soa, found
+
+    def delete(self, keys: np.ndarray) -> None:
+        with self._lock:
+            for k in keys:
+                self.index.pop(int(k), None)
+
+    def keys(self) -> np.ndarray:
+        with self._lock:
+            return np.fromiter(self.index.keys(), np.uint64,
+                               len(self.index))
+
+    def compact(self) -> None:
+        """Rewrite only live rows (≙ rocksdb compaction / Shrink)."""
+        with self._lock:
+            live = list(self.index.items())
+            tmp = self.path + ".compact"
+            with open(self.path, "rb") as src, open(tmp, "wb") as dst:
+                dst.write(_MAGIC)
+                new_index = {}
+                for key, off in live:
+                    src.seek(off)
+                    row = src.read(self.row_bytes)
+                    new_index[key] = dst.tell()
+                    dst.write(row)
+            os.replace(tmp, self.path)
+            self.index = new_index
+
+    def __len__(self):
+        return len(self.index)
+
+
+class SSDTieredTable:
+    """DRAM + SSD two-tier wrapper around ShardedHostTable.
+
+    spill(): demote cold rows (score below cache threshold ≙
+    `_cache_tk_size` top-k policy, ssd_sparse_table.h:82) to the SSD shards;
+    bulk_pull transparently faults them back in.
+    """
+
+    def __init__(self, host_table, directory: str):
+        self.host = host_table
+        self.dir = directory
+        self.shards = [
+            SSDShard(os.path.join(directory, f"shard-{i:04d}.log"),
+                     host_table.mf_dim)
+            for i in range(host_table.shard_num)]
+
+    def _shard_ids(self, keys):
+        return self.host._shard_ids(keys)
+
+    def spill(self, score_threshold: float) -> int:
+        """Demote host rows with score < threshold to SSD."""
+        spilled = 0
+        for si, shard in enumerate(self.host._shards):
+            with shard.lock:
+                score = self.host._score(shard.soa)
+                cold = score < score_threshold
+                if not cold.any():
+                    continue
+                keys = shard.keys[cold]
+                soa = {f: arr[cold] for f, arr in shard.soa.items()}
+                self.shards[si].write_rows(keys, soa)
+                keep = ~cold
+                shard.keys = shard.keys[keep]
+                for f in shard.soa:
+                    shard.soa[f] = shard.soa[f][keep]
+                spilled += int(cold.sum())
+        return spilled
+
+    def bulk_pull(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
+        """Host rows, faulting SSD-resident rows back into DRAM
+        (≙ LoadSSD2Mem box_wrapper.h:640)."""
+        out = self.host.bulk_pull(keys)
+        # determine which keys were absent from DRAM → try SSD
+        sid = self._shard_ids(keys)
+        for si in range(self.host.shard_num):
+            sel = np.nonzero(sid == si)[0]
+            if not len(sel):
+                continue
+            _, in_dram = self.host._shards[si].lookup(keys[sel])
+            miss = sel[~in_dram]
+            if not len(miss):
+                continue
+            soa, found = self.shards[si].read_rows(keys[miss])
+            hit = miss[found]
+            if len(hit):
+                for f in out:
+                    out[f][hit] = soa[f][found]
+                # promote back to DRAM and drop from SSD
+                self.host.bulk_write(
+                    keys[hit], {f: out[f][hit] for f in out})
+                self.shards[si].delete(keys[hit])
+        return out
+
+    def total_size(self) -> int:
+        return self.host.size() + sum(len(s) for s in self.shards)
+
+    def compact(self) -> None:
+        for s in self.shards:
+            s.compact()

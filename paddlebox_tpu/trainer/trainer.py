@@ -113,7 +113,7 @@ class SparseTrainer:
 
             # 7. metrics on device (≙ AddAucMonitor boxps_worker.cc:1337)
             auc_state = accumulate_auc(auc_state, preds, labels, valid)
-            return ws, params, opt_state, auc_state, loss
+            return ws, params, opt_state, auc_state, loss, preds
 
         donate = (0, 1, 2, 3)
         self._step_fn = jax.jit(step, donate_argnums=donate)
@@ -165,6 +165,15 @@ class SparseTrainer:
         opt_state, auc_state = self.opt_state, self.auc_state
         n_batches = 0
         losses = []
+        dump_file = None
+        if self.trainer_config.dump_path:
+            # ≙ TrainerDesc dump_fields/dump_path (trainer_desc.proto:38-40,
+            # DumpWorkField): per-instance "ins_id\tlabel\tpred" lines
+            import os
+            os.makedirs(self.trainer_config.dump_path, exist_ok=True)
+            dump_file = open(
+                f"{self.trainer_config.dump_path}/dump-pass-"
+                f"{self.engine.pass_id}.txt", "w")
         while True:
             try:
                 batch = ch.get()
@@ -172,14 +181,22 @@ class SparseTrainer:
                 break
             dev = self._put_batch(batch)
             with self.timers("step"):
-                ws, params, opt_state, auc_state, loss = self._step_fn(
-                    ws, params, opt_state, auc_state, *dev)
+                ws, params, opt_state, auc_state, loss, preds = \
+                    self._step_fn(ws, params, opt_state, auc_state, *dev)
             if self._check_nan and not np.isfinite(float(loss)):
                 raise FloatingPointError(
                     f"NaN/Inf loss at batch {n_batches}")
+            if dump_file is not None:
+                p = np.asarray(preds)[:batch.num_real]
+                lbl = batch.labels[:batch.num_real]
+                ids = batch.ins_ids or [""] * batch.num_real
+                for i in range(batch.num_real):
+                    dump_file.write(f"{ids[i]}\t{lbl[i]:g}\t{p[i]:.6f}\n")
             losses.append(loss)
             n_batches += 1
         t.join()
+        if dump_file is not None:
+            dump_file.close()
         engine.ws = ws
         self.params = params
         self.opt_state = opt_state
