@@ -107,6 +107,8 @@ class EmbeddingTableConfig:
     accessor: AccessorConfig = dataclasses.field(default_factory=AccessorConfig)
     shard_num: int = 16              # host-table shards (≙ memory_sparse_table.h:46)
     quant_bits: int = 0              # 0 = no embedding quantization
+    expand_dim: int = 0              # NNCross second embedding width
+                                     # (≙ expand_embed_dim, pull_box_extended)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,51 @@
+"""rank_attention op (≙ operators/rank_attention_op.{cc,cu} +
+rank_attention.cu.h kernels expand_input_by_rank_kernel :28 and
+expand_rank_attention_param_kernel :67).
+
+Semantics: each instance carries its own rank (1-based; 0 = absent) and up to
+``max_rank`` peer entries (rank, input-row-index) in ``rank_offset``
+[B, 1 + 2*max_rank].  The op selects, per (own_rank, peer_rank) pair, a
+parameter block [in_col, out_col] from rank_param (laid out
+[max_rank*max_rank*in_col, out_col], block id = own*max_rank + peer — the
+``start = lower*max_rank + faster`` addressing at rank_attention.cu.h:90),
+gathers the peer input rows, and contracts:
+    out[b] = Σ_k  x[index_bk] @ P[own_b, peer_bk]
+TPU-first: instead of materializing the expanded [B, max_rank*in_col] input
+and parameter copies (InputHelp/ParamHelp workspaces), one batched einsum —
+gathers feed the MXU directly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rank_attention(x: jnp.ndarray, rank_offset: jnp.ndarray,
+                   rank_param: jnp.ndarray, max_rank: int = 3):
+    """x [B, in_col]; rank_offset [B, 1+2*max_rank] int32;
+    rank_param [max_rank*max_rank*in_col, out_col].
+    → (out [B, out_col], ins_rank [B])."""
+    B, in_col = x.shape
+    out_col = rank_param.shape[-1]
+    param = rank_param.reshape(max_rank * max_rank, in_col, out_col)
+
+    own = rank_offset[:, 0] - 1                       # [B]
+    peer = rank_offset[:, 1::2] - 1                   # [B, K]
+    index = rank_offset[:, 2::2]                      # [B, K]
+    valid = (own[:, None] >= 0) & (peer >= 0)         # [B, K]
+
+    xin = x[jnp.clip(index, 0, B - 1)]                # [B, K, in_col]
+    block_id = jnp.clip(own[:, None], 0, max_rank - 1) * max_rank \
+        + jnp.clip(peer, 0, max_rank - 1)
+    blocks = param[block_id]                          # [B, K, in_col, out_col]
+    w = valid.astype(x.dtype)[..., None]
+    out = jnp.einsum("bki,bkio->bo", xin * w, blocks)
+    ins_rank = rank_offset[:, 0].astype(x.dtype)
+    return out, ins_rank
+
+
+def batch_fc(x: jnp.ndarray, w: jnp.ndarray, bias: jnp.ndarray) -> jnp.ndarray:
+    """≙ operators/batch_fc_op.cu: per-slot batched FC.
+    x [S, B, in], w [S, in, out], bias [S, out] → [S, B, out]."""
+    return jnp.einsum("sbi,sio->sbo", x, w) + bias[:, None, :]

@@ -87,7 +87,9 @@ def build_working_set(host_soa: Dict[str, np.ndarray], mf_dim: int,
     total = (pad_to if pad_to is not None else size_bucket(n + 1))
     assert total >= n + 1
     ws = {}
-    for f in DEVICE_FIELDS:
+    for f in host_soa:
+        if f == "unseen_days":  # host-only lifecycle field
+            continue
         src = host_soa[f]
         shape = (total,) + src.shape[1:]
         arr = np.zeros(shape, src.dtype)
@@ -104,7 +106,7 @@ def dump_working_set(ws: Dict[str, jnp.ndarray], n: int
                      ) -> Dict[str, np.ndarray]:
     """Device→host for end_pass write-back (≙ dump_pool_to_cpu_func,
     ps_gpu_wrapper.cc:983+ / accessor DumpFill)."""
-    return {f: np.asarray(ws[f])[1:n + 1] for f in DEVICE_FIELDS}
+    return {f: np.asarray(ws[f])[1:n + 1] for f in ws}
 
 
 def pull_sparse(ws: Dict[str, jnp.ndarray], indices: jnp.ndarray
@@ -122,6 +124,17 @@ def pull_sparse(ws: Dict[str, jnp.ndarray], indices: jnp.ndarray
     mf = ws["mf"][indices] * created[..., None]
     return jnp.concatenate(
         [show[..., None], click[..., None], embed_w[..., None], mf], axis=-1)
+
+
+def pull_sparse_extended(ws: Dict[str, jnp.ndarray], indices: jnp.ndarray
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """≙ pull_box_extended_sparse / PullCopyNNCross (box_wrapper.cu:147):
+    base pull value plus the expand ("NNCross") embedding, gated by the same
+    mf-created mask."""
+    base = pull_sparse(ws, indices)
+    created = (ws["mf_size"][indices] > 0).astype(ws["mf_ex"].dtype)
+    emb_ex = ws["mf_ex"][indices] * created[..., None]
+    return base, emb_ex
 
 
 def push_sparse_grads(ws: Dict[str, jnp.ndarray], indices: jnp.ndarray,
@@ -153,4 +166,14 @@ def push_sparse_grads(ws: Dict[str, jnp.ndarray], indices: jnp.ndarray,
         "slot": jnp.zeros((n,), jnp.int32).at[flat_idx].max(
             flat_slot.astype(jnp.int32)),
     }
+    return acc
+
+
+def push_sparse_grads_extended(ws, indices, grads, grads_ex, slot_ids):
+    """Extended push: base accumulators + expand-embedding grads
+    (≙ push_box_extended_sparse)."""
+    acc = push_sparse_grads(ws, indices, grads, slot_ids)
+    flat_idx = indices.reshape(-1)
+    flat_gx = grads_ex.reshape(-1, grads_ex.shape[-1])
+    acc["g_embedx_ex"] = jnp.zeros_like(ws["mf_ex"]).at[flat_idx].add(flat_gx)
     return acc

@@ -40,18 +40,30 @@ HOST_FIELDS = (
                                     # until mf_size > 0 — see optimizer.py)
 )
 
+# optional expand ("NNCross") embedding fields, present when
+# EmbeddingTableConfig.expand_dim > 0 (≙ PullCopyNNCross box_wrapper.cu:147
+# and pull_box_extended_sparse_op)
+EXPAND_FIELDS = (
+    ("mf_ex", np.float32, ("E",)),
+    ("mf_ex_g2sum", np.float32, ()),
+)
 
-def empty_soa(n: int, mf_dim: int) -> Dict[str, np.ndarray]:
+
+def empty_soa(n: int, mf_dim: int, expand_dim: int = 0
+              ) -> Dict[str, np.ndarray]:
     out = {}
-    for name, dtype, suffix in HOST_FIELDS:
-        shape = (n,) + tuple(mf_dim if s == "D" else s for s in suffix)
+    fields = HOST_FIELDS + (EXPAND_FIELDS if expand_dim > 0 else ())
+    for name, dtype, suffix in fields:
+        shape = (n,) + tuple(
+            mf_dim if s == "D" else (expand_dim if s == "E" else s)
+            for s in suffix)
         out[name] = np.zeros(shape, dtype=dtype)
     return out
 
 
 def default_rows(n: int, mf_dim: int, rng: np.random.Generator,
-                 mf_initial_range: float, initial_range: float = 0.0
-                 ) -> Dict[str, np.ndarray]:
+                 mf_initial_range: float, initial_range: float = 0.0,
+                 expand_dim: int = 0) -> Dict[str, np.ndarray]:
     """Fresh feature rows for keys unseen by the host table.
 
     embed_w ~ U(-initial_range, initial_range) (CPU rule init; default range 0
@@ -59,12 +71,15 @@ def default_rows(n: int, mf_dim: int, rng: np.random.Generator,
     ~ U(0, mf_initial_range) (≙ curand_uniform * mf_initial_range,
     optimizer.cuh.h:119-121) which stays masked until mf_size > 0.
     """
-    soa = empty_soa(n, mf_dim)
+    soa = empty_soa(n, mf_dim, expand_dim)
     if initial_range > 0:
         soa["embed_w"] = rng.uniform(
             -initial_range, initial_range, size=(n,)).astype(np.float32)
     soa["mf"] = rng.uniform(
         0.0, mf_initial_range, size=(n, mf_dim)).astype(np.float32)
+    if expand_dim > 0:
+        soa["mf_ex"] = rng.uniform(
+            0.0, mf_initial_range, size=(n, expand_dim)).astype(np.float32)
     return soa
 
 

@@ -25,9 +25,9 @@ from paddlebox_tpu.ps import feature_value as fv
 
 
 class _Shard:
-    def __init__(self, mf_dim: int):
+    def __init__(self, mf_dim: int, expand_dim: int = 0):
         self.keys = np.empty((0,), np.uint64)
-        self.soa = fv.empty_soa(0, mf_dim)
+        self.soa = fv.empty_soa(0, mf_dim, expand_dim)
         self.mf_dim = mf_dim
         self.lock = threading.Lock()
 
@@ -70,8 +70,10 @@ class ShardedHostTable:
     def __init__(self, config: EmbeddingTableConfig, seed: int = 0):
         self.config = config
         self.mf_dim = config.embedding_dim
+        self.expand_dim = config.expand_dim
         self.shard_num = config.shard_num
-        self._shards = [_Shard(self.mf_dim) for _ in range(self.shard_num)]
+        self._shards = [_Shard(self.mf_dim, self.expand_dim)
+                        for _ in range(self.shard_num)]
         self._rng = np.random.default_rng(seed)
 
     # -- introspection -------------------------------------------------------
@@ -89,7 +91,8 @@ class ShardedHostTable:
         n = len(keys)
         out = fv.default_rows(n, self.mf_dim, self._rng,
                               self.config.sgd.mf_initial_range,
-                              self.config.sgd.initial_range)
+                              self.config.sgd.initial_range,
+                              self.expand_dim)
         sid = self._shard_ids(keys)
         for s, shard in enumerate(self._shards):
             sel = np.nonzero(sid == s)[0]

@@ -96,9 +96,20 @@ def sparse_adagrad_apply(ws: Dict[str, jnp.ndarray],
         cfg.mf_learning_rate, cfg.mf_initial_g2sum, cfg.mf_min_bound,
         cfg.mf_max_bound, mf_touched, mf_dim)
 
-    return {"show": show, "click": click, "delta_score": delta, "slot": slot,
-            "embed_w": embed_w, "embed_g2sum": embed_g2sum,
-            "mf_size": mf_size, "mf_g2sum": mf_g2sum, "mf": mf}
+    out = {"show": show, "click": click, "delta_score": delta, "slot": slot,
+           "embed_w": embed_w, "embed_g2sum": embed_g2sum,
+           "mf_size": mf_size, "mf_g2sum": mf_g2sum, "mf": mf}
+    if "mf_ex" in ws:  # expand (NNCross) embedding trains like mf
+        if "g_embedx_ex" in acc:
+            mf_ex, mf_ex_g2 = _adagrad_update(
+                ws["mf_ex"], ws["mf_ex_g2sum"], acc["g_embedx_ex"],
+                acc["g_show"], cfg.mf_learning_rate, cfg.mf_initial_g2sum,
+                cfg.mf_min_bound, cfg.mf_max_bound, mf_touched,
+                ws["mf_ex"].shape[1])
+            out["mf_ex"], out["mf_ex_g2sum"] = mf_ex, mf_ex_g2
+        else:
+            out["mf_ex"], out["mf_ex_g2sum"] = ws["mf_ex"], ws["mf_ex_g2sum"]
+    return out
 
 
 def sparse_adam_apply(ws: Dict[str, jnp.ndarray], acc: Dict[str, jnp.ndarray],
@@ -148,10 +159,14 @@ def sparse_adam_apply(ws: Dict[str, jnp.ndarray], acc: Dict[str, jnp.ndarray],
                    jnp.clip(new_mf, cfg.mf_min_bound, cfg.mf_max_bound),
                    ws["mf"])
 
-    return {"show": show, "click": click, "delta_score": delta,
-            "slot": jnp.where(touched, acc["slot"], ws["slot"]),
-            "embed_w": embed_w, "embed_g2sum": v,
-            "mf_size": mf_size, "mf_g2sum": vx, "mf": mf}
+    out = {"show": show, "click": click, "delta_score": delta,
+           "slot": jnp.where(touched, acc["slot"], ws["slot"]),
+           "embed_w": embed_w, "embed_g2sum": v,
+           "mf_size": mf_size, "mf_g2sum": vx, "mf": mf}
+    for extra in ("mf_ex", "mf_ex_g2sum"):
+        if extra in ws:
+            out[extra] = ws[extra]
+    return out
 
 
 OPTIMIZERS = {
