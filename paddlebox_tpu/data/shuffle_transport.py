@@ -1,0 +1,142 @@
+"""TCP transport for the inter-node global shuffle.
+
+≙ boxps::PaddleShuffler (closed-source MPI transport driven from
+data_set.cc:1910-1929 send_message_callback / ReceiveSuffleData
+:2548): length-prefixed record-block messages between ranks, with DONE
+markers standing in for the MPI barrier + wait_done.  Runs over plain
+sockets (loopback or DCN) so the dataset shuffle works across launcher
+processes without MPI.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from paddlebox_tpu.data.dataset import ShuffleTransport
+from paddlebox_tpu.data.slot_record import SlotRecordBlock
+from paddlebox_tpu.utils.channel import Channel
+
+_MSG_BLOCK = 0
+_MSG_DONE = 1
+
+
+def _send_msg(sock: socket.socket, kind: int, payload: bytes) -> None:
+    sock.sendall(struct.pack("<BQ", kind, len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+class TcpShuffleTransport(ShuffleTransport):
+    def __init__(self, rank: int, addrs: Sequence[Tuple[str, int]]):
+        self._rank = rank
+        self._addrs = list(addrs)
+        self._world = len(addrs)
+        self._mail = Channel()
+        self._done_from = set()
+        self._done_lock = threading.Lock()
+        self._done_cv = threading.Condition(self._done_lock)
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+
+        host, port = self._addrs[rank]
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(self._world)
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    # ------------------------------------------------------------------
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._recv_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _recv_loop(self, conn: socket.socket):
+        try:
+            while True:
+                head = _recv_exact(conn, 9)
+                kind, length = struct.unpack("<BQ", head)
+                payload = _recv_exact(conn, length) if length else b""
+                if kind == _MSG_BLOCK:
+                    self._mail.put(pickle.loads(payload))
+                elif kind == _MSG_DONE:
+                    src = struct.unpack("<I", payload)[0]
+                    with self._done_cv:
+                        self._done_from.add(src)
+                        self._done_cv.notify_all()
+        except (ConnectionError, OSError):
+            return
+
+    def _conn_to(self, dst: int) -> socket.socket:
+        with self._conn_lock:
+            if dst not in self._conns:
+                s = socket.create_connection(self._addrs[dst], timeout=30)
+                self._conns[dst] = s
+            return self._conns[dst]
+
+    # ------------------------------------------------------------------
+    def send(self, dst: int, block: SlotRecordBlock) -> None:
+        payload = pickle.dumps(block, protocol=pickle.HIGHEST_PROTOCOL)
+        sock = self._conn_to(dst)
+        with self._conn_lock:
+            _send_msg(sock, _MSG_BLOCK, payload)
+
+    def barrier(self) -> None:
+        """Signal DONE to every peer, then wait for every peer's DONE
+        (≙ PaddleShuffler wait_done)."""
+        me = struct.pack("<I", self._rank)
+        for dst in range(self._world):
+            if dst == self._rank:
+                continue
+            sock = self._conn_to(dst)
+            with self._conn_lock:
+                _send_msg(sock, _MSG_DONE, me)
+        with self._done_cv:
+            while len(self._done_from) < self._world - 1:
+                if not self._done_cv.wait(timeout=60):
+                    raise TimeoutError("shuffle barrier timed out")
+            self._done_from.clear()
+
+    def drain(self) -> List[SlotRecordBlock]:
+        out = []
+        while self._mail.size():
+            out.append(self._mail.get())
+        return out
+
+    def close(self) -> None:
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            for s in self._conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass

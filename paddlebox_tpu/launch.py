@@ -1,0 +1,118 @@
+"""Multi-process launcher + distributed runtime init.
+
+≙ `python -m paddle.distributed.launch` (launch/main.py + controllers/):
+spawns one worker process per host rank with the rendezvous env, restarts
+failed locals, and tears the job down on fatal errors.  The TPU analogue of
+the rendezvous itself is ``jax.distributed.initialize`` (coordinator =
+process 0), which stands in for MPICluster/gloo (SURVEY.md §5 backend map).
+
+Usage:
+    python -m paddlebox_tpu.launch --nproc_per_node 2 train.py --args...
+Inside the worker, call ``init_distributed()`` before building topology.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> int:
+    """≙ fleet.init collective rendezvous (MPICluster box_wrapper.h:446).
+    Reads PBOX_* env set by the launcher when args are omitted.  Returns
+    this process's rank.  No-op for single-process jobs."""
+    import jax
+    num = num_processes if num_processes is not None else \
+        int(os.environ.get("PBOX_WORLD_SIZE", "1"))
+    if num <= 1:
+        return 0
+    rank = process_id if process_id is not None else \
+        int(os.environ.get("PBOX_RANK", "0"))
+    coord = coordinator or os.environ.get("PBOX_COORDINATOR",
+                                          "127.0.0.1:12355")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=num, process_id=rank)
+    return rank
+
+
+def launch(script: str, script_args: List[str], nproc: int,
+           coordinator: str = "127.0.0.1:12355",
+           max_restarts: int = 0, log_dir: str = "") -> int:
+    """Spawn nproc workers; restart failed ones up to max_restarts
+    (≙ launch controllers' replica watch)."""
+    procs: List[Optional[subprocess.Popen]] = [None] * nproc
+    restarts = [0] * nproc
+
+    def spawn(rank: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.update({
+            "PBOX_RANK": str(rank),
+            "PBOX_WORLD_SIZE": str(nproc),
+            "PBOX_COORDINATOR": coordinator,
+        })
+        stdout = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            stdout = open(os.path.join(log_dir, f"worker-{rank}.log"), "ab")
+        return subprocess.Popen([sys.executable, script] + script_args,
+                                env=env, stdout=stdout,
+                                stderr=subprocess.STDOUT if stdout else None)
+
+    for r in range(nproc):
+        procs[r] = spawn(r)
+
+    exit_code = 0
+    try:
+        while True:
+            alive = 0
+            for r, p in enumerate(procs):
+                if p is None:
+                    continue
+                ret = p.poll()
+                if ret is None:
+                    alive += 1
+                elif ret != 0 and restarts[r] < max_restarts:
+                    restarts[r] += 1
+                    procs[r] = spawn(r)
+                    alive += 1
+                elif ret != 0:
+                    # fatal: kill the rest (≙ controller abort)
+                    exit_code = ret
+                    for q in procs:
+                        if q is not None and q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    return exit_code
+                else:
+                    procs[r] = None
+            if alive == 0:
+                return exit_code
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q is not None and q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        return 130
+
+
+def main():
+    ap = argparse.ArgumentParser(prog="paddlebox_tpu.launch")
+    ap.add_argument("--nproc_per_node", type=int, default=1)
+    ap.add_argument("--coordinator", default="127.0.0.1:12355")
+    ap.add_argument("--max_restarts", type=int, default=0)
+    ap.add_argument("--log_dir", default="")
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    sys.exit(launch(args.script, args.script_args, args.nproc_per_node,
+                    args.coordinator, args.max_restarts, args.log_dir))
+
+
+if __name__ == "__main__":
+    main()

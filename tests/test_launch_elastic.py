@@ -1,0 +1,92 @@
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.elastic import ElasticManager, FileStore
+from paddlebox_tpu.launch import launch
+from paddlebox_tpu.config import DataFeedConfig, SlotConfig
+from paddlebox_tpu.data.data_feed import SlotParser
+from paddlebox_tpu.data.dataset import SlotDataset
+from paddlebox_tpu.data.shuffle_transport import TcpShuffleTransport
+
+
+def test_filestore_ttl(tmp_path):
+    store = FileStore(str(tmp_path), ttl=0.3)
+    store.put("rank-0", {"rank": 0})
+    assert store.get("rank-0") == {"rank": 0}
+    assert store.alive_keys() == ["rank-0"]
+    time.sleep(0.4)
+    assert store.get("rank-0") is None
+    assert store.alive_keys() == []
+
+
+def test_elastic_detects_member_loss(tmp_path):
+    store = FileStore(str(tmp_path), ttl=1.0)
+    m0 = ElasticManager(store, rank=0, world_size=2,
+                        heartbeat_interval=0.2)
+    m1 = ElasticManager(store, rank=1, world_size=2,
+                        heartbeat_interval=0.2)
+    changes = []
+    m0.on_membership_change(lambda members: changes.append(list(members)))
+    m0.start()
+    m1.start()
+    time.sleep(0.5)
+    assert m0.healthy()
+    m1.stop()  # rank 1 leaves
+    time.sleep(1.5)
+    assert not m0.healthy()
+    assert changes and all("rank-00001" not in c for c in changes[-1:])
+    m0.stop()
+
+
+def test_launcher_spawns_and_collects(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = os.environ["PBOX_RANK"]
+        world = os.environ["PBOX_WORLD_SIZE"]
+        print(f"worker {rank}/{world}")
+        sys.exit(0)
+    """))
+    code = launch(str(script), [], nproc=3, log_dir=str(tmp_path / "logs"))
+    assert code == 0
+    logs = sorted(os.listdir(tmp_path / "logs"))
+    assert logs == ["worker-0.log", "worker-1.log", "worker-2.log"]
+
+
+def test_launcher_propagates_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    assert launch(str(script), [], nproc=2) == 3
+
+
+def test_tcp_shuffle_transport():
+    cfg = DataFeedConfig(slots=(SlotConfig("s", capacity=2),))
+    parser = SlotParser(cfg)
+    ports = [29371, 29372]
+    addrs = [("127.0.0.1", p) for p in ports]
+    transports = [TcpShuffleTransport(r, addrs) for r in range(2)]
+    datasets = []
+    for r in range(2):
+        ds = SlotDataset(cfg, transport=transports[r])
+        ds._blocks = [parser.parse_block(
+            [f"1 {100 * r + i}" for i in range(8)])]
+        datasets.append(ds)
+    threads = [threading.Thread(target=ds.global_shuffle) for ds in datasets]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    keys = []
+    for ds in datasets:
+        for b in ds.get_blocks():
+            keys.extend(b.uint64_slots["s"][0].tolist())
+    assert sorted(keys) == sorted(100 * r + i for r in range(2)
+                                  for i in range(8))
+    for tr in transports:
+        tr.close()
