@@ -1,0 +1,237 @@
+"""RPC parameter-server service — the CPU PS tier over the network.
+
+≙ PSCORE's brpc server/client (ps/service/brpc_ps_server.{h,cc},
+brpc_ps_client.{h,cc}): push/pull sparse & dense against tables sharded by
+``key % shard_num``, plus save/load/shrink/barrier control verbs.  The
+TPU rebuild keeps the same wire verbs over length-prefixed TCP messages
+(zero-egress pods: no brpc/grpc dependency) — trainers on other hosts pull
+pass working sets from, and flush them to, this service instead of their
+local DRAM (the multi-host BuildPull path, ps_gpu_wrapper.cc:337-419,
+including the retry-then-fail discipline :388-419).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.config import EmbeddingTableConfig
+from paddlebox_tpu.ps.host_table import ShardedHostTable
+
+
+def _send(sock, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv(sock):
+    head = b""
+    while len(head) < 8:
+        chunk = sock.recv(8 - len(head))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        head += chunk
+    (length,) = struct.unpack("<Q", head)
+    buf = bytearray()
+    while len(buf) < length:
+        chunk = sock.recv(min(1 << 20, length - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return pickle.loads(bytes(buf))
+
+
+class PSServer:
+    """Hosts one ShardedHostTable + a dense blob store behind TCP verbs:
+    pull_sparse/push_sparse/pull_dense/push_dense/save/load/shrink/
+    end_day/size/barrier (the BrpcPsService cmd surface)."""
+
+    def __init__(self, table: ShardedHostTable, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.table = table
+        self.dense: Dict[str, np.ndarray] = {}
+        self._dense_lock = threading.Lock()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._barrier_cv = threading.Condition()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        req = _recv(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    try:
+                        resp = outer._dispatch(req)
+                    except Exception as e:  # noqa: BLE001
+                        resp = {"ok": False, "error": repr(e)}
+                    _send(self.request, resp)
+
+        self._srv = socketserver.ThreadingTCPServer((host, port), Handler,
+                                                    bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self.addr: Tuple[str, int] = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def _dispatch(self, req: Dict) -> Dict:
+        cmd = req["cmd"]
+        if cmd == "pull_sparse":
+            rows = self.table.bulk_pull(req["keys"])
+            return {"ok": True, "rows": rows}
+        if cmd == "push_sparse":
+            self.table.bulk_write(req["keys"], req["rows"])
+            return {"ok": True}
+        if cmd == "pull_dense":
+            with self._dense_lock:
+                return {"ok": True, "value": self.dense.get(req["name"])}
+        if cmd == "push_dense":
+            with self._dense_lock:
+                if req.get("add"):
+                    cur = self.dense.get(req["name"])
+                    self.dense[req["name"]] = (req["value"] if cur is None
+                                               else cur + req["value"])
+                else:
+                    self.dense[req["name"]] = req["value"]
+            return {"ok": True}
+        if cmd == "save":
+            n = self.table.save(req["path"], req.get("mode", "all"))
+            return {"ok": True, "saved": n}
+        if cmd == "load":
+            return {"ok": True, "loaded": self.table.load(req["path"])}
+        if cmd == "shrink":
+            return {"ok": True, "removed": self.table.shrink()}
+        if cmd == "end_day":
+            self.table.end_day()
+            return {"ok": True}
+        if cmd == "size":
+            return {"ok": True, "size": self.table.size()}
+        if cmd == "barrier":
+            world = req["world"]
+            with self._barrier_cv:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+                if self._barrier_count >= world:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                else:
+                    while self._barrier_gen == gen:
+                        if not self._barrier_cv.wait(timeout=60):
+                            raise TimeoutError("ps barrier timeout")
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown cmd {cmd}"}
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class PSClient:
+    """≙ BrpcPsClient: sticky connection, bulk verbs, bounded retries
+    (3-retry-then-raise ≙ ps_gpu_wrapper.cc:388-419)."""
+
+    def __init__(self, addr: Tuple[str, int], retries: int = 3,
+                 retry_sleep: float = 0.5):
+        self.addr = tuple(addr)
+        self.retries = retries
+        self.retry_sleep = retry_sleep
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _call(self, req: Dict) -> Dict:
+        last_err = None
+        for _ in range(self.retries):
+            try:
+                with self._lock:
+                    if self._sock is None:
+                        self._sock = socket.create_connection(self.addr,
+                                                              timeout=60)
+                    _send(self._sock, req)
+                    resp = _recv(self._sock)
+                if not resp.get("ok"):
+                    raise RuntimeError(resp.get("error", "ps error"))
+                return resp
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                with self._lock:
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                time.sleep(self.retry_sleep)
+        raise ConnectionError(f"ps unreachable after retries: {last_err}")
+
+    # -- verbs --------------------------------------------------------------
+    def pull_sparse(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
+        return self._call({"cmd": "pull_sparse", "keys": keys})["rows"]
+
+    def push_sparse(self, keys: np.ndarray, rows: Dict[str, np.ndarray]):
+        self._call({"cmd": "push_sparse", "keys": keys, "rows": rows})
+
+    def pull_dense(self, name: str) -> Optional[np.ndarray]:
+        return self._call({"cmd": "pull_dense", "name": name})["value"]
+
+    def push_dense(self, name: str, value: np.ndarray, add: bool = False):
+        self._call({"cmd": "push_dense", "name": name, "value": value,
+                    "add": add})
+
+    def save(self, path: str, mode: str = "all") -> int:
+        return self._call({"cmd": "save", "path": path, "mode": mode})["saved"]
+
+    def load(self, path: str) -> int:
+        return self._call({"cmd": "load", "path": path})["loaded"]
+
+    def shrink(self) -> int:
+        return self._call({"cmd": "shrink"})["removed"]
+
+    def end_day(self) -> None:
+        self._call({"cmd": "end_day"})
+
+    def size(self) -> int:
+        return self._call({"cmd": "size"})["size"]
+
+    def barrier(self, world: int) -> None:
+        self._call({"cmd": "barrier", "world": world})
+
+
+class RemoteTableAdapter:
+    """Duck-types ShardedHostTable's pass-batched surface over a PSClient so
+    BoxPSEngine can run against a remote PS
+    (engine.table = RemoteTableAdapter(client))."""
+
+    def __init__(self, client: PSClient):
+        self.client = client
+
+    def bulk_pull(self, keys):
+        return self.client.pull_sparse(keys)
+
+    def bulk_write(self, keys, soa):
+        self.client.push_sparse(keys, soa)
+
+    def end_day(self):
+        self.client.end_day()
+
+    def shrink(self):
+        return self.client.shrink()
+
+    def save(self, path, mode="all"):
+        return self.client.save(path, mode)
+
+    def load(self, path):
+        return self.client.load(path)
+
+    def size(self):
+        return self.client.size()

@@ -1,0 +1,85 @@
+import threading
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import EmbeddingTableConfig
+from paddlebox_tpu.ps.host_table import ShardedHostTable
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.ps.service import PSClient, PSServer, RemoteTableAdapter
+
+
+@pytest.fixture()
+def server():
+    table = ShardedHostTable(EmbeddingTableConfig(embedding_dim=3,
+                                                  shard_num=4))
+    srv = PSServer(table)
+    yield srv
+    srv.shutdown()
+
+
+def test_sparse_roundtrip(server):
+    client = PSClient(server.addr)
+    keys = np.array([1, 2, 3], np.uint64)
+    rows = client.pull_sparse(keys)
+    rows["show"][:] = [5, 6, 7]
+    client.push_sparse(keys, rows)
+    assert client.size() == 3
+    back = client.pull_sparse(np.array([3, 1], np.uint64))
+    np.testing.assert_allclose(back["show"], [7, 5])
+
+
+def test_dense_and_lifecycle(server, tmp_path):
+    client = PSClient(server.addr)
+    client.push_dense("w", np.ones(4))
+    client.push_dense("w", np.ones(4) * 2, add=True)
+    np.testing.assert_allclose(client.pull_dense("w"), [3, 3, 3, 3])
+    assert client.pull_dense("missing") is None
+
+    keys = np.array([10], np.uint64)
+    rows = client.pull_sparse(keys)
+    rows["show"][:] = 100.0
+    client.push_sparse(keys, rows)
+    client.end_day()
+    np.testing.assert_allclose(
+        client.pull_sparse(keys)["show"], [98.0])
+    assert client.save(str(tmp_path / "m")) == 1
+
+
+def test_barrier(server):
+    clients = [PSClient(server.addr) for _ in range(3)]
+    done = []
+
+    def worker(c):
+        c.barrier(3)
+        done.append(1)
+
+    threads = [threading.Thread(target=worker, args=(c,)) for c in clients]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(done) == 3
+
+
+def test_engine_over_remote_table(server):
+    """BoxPSEngine running its pass lifecycle against the remote PS
+    (the multi-host BuildPull path)."""
+    engine = BoxPSEngine(EmbeddingTableConfig(embedding_dim=3, shard_num=4))
+    engine.table = RemoteTableAdapter(PSClient(server.addr))
+    engine.begin_feed_pass()
+    engine.add_keys(np.array([11, 22, 33], np.uint64))
+    engine.end_feed_pass()
+    engine.begin_pass()
+    engine.ws["show"] = engine.ws["show"].at[1:4].add(2.0)
+    engine.end_pass()
+    client = PSClient(server.addr)
+    np.testing.assert_allclose(
+        client.pull_sparse(np.array([11, 22, 33], np.uint64))["show"],
+        [2.0, 2.0, 2.0])
+
+
+def test_client_retries_unreachable():
+    client = PSClient(("127.0.0.1", 9), retries=2, retry_sleep=0.05)
+    with pytest.raises(ConnectionError):
+        client.size()
