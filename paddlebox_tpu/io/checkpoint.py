@@ -1,0 +1,119 @@
+"""Checkpoint / resume orchestration.
+
+≙ the reference's two-tier day/pass persistence (SURVEY.md §5): sparse
+SaveBase/SaveDelta + dense save_persistables, re-driven by date from ops
+scripts.  The rebuild adds what the reference lacked: a single
+``TrainCheckpoint`` that atomically captures {dense params, optimizer state,
+metric state, day/pass cursor} next to the sparse table dump so a killed job
+resumes mid-day (`resume()` → last completed pass).
+
+Layout:
+  <root>/sparse/…            per-shard npz (ShardedHostTable.save mode=all)
+  <root>/dense.msgpack       flax-serialized params/opt_state pytree
+  <root>/STATE.json          {day_id, pass_id, step, auc_state?}
+  <root>/xbox/…              serving dump (save_xbox)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+
+from flax import serialization
+
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+class TrainCheckpoint:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def save(self, engine: BoxPSEngine, trainer, extra: Optional[Dict] = None
+             ) -> None:
+        """Capture engine table + trainer dense state + cursor."""
+        sparse_dir = os.path.join(self.root, "sparse.tmp")
+        if os.path.exists(sparse_dir):
+            shutil.rmtree(sparse_dir)
+        engine.table.save(sparse_dir, mode="all")
+        final = os.path.join(self.root, "sparse")
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(sparse_dir, final)
+
+        dense = {
+            "params": jax.device_get(trainer.params),
+            "opt_state": jax.device_get(trainer.opt_state),
+        }
+        _atomic_write(os.path.join(self.root, "dense.msgpack"),
+                      serialization.to_bytes(dense))
+
+        state = {"day_id": engine.day_id, "pass_id": engine.pass_id,
+                 "phase": engine.phase}
+        if extra:
+            state.update(extra)
+        _atomic_write(os.path.join(self.root, "STATE.json"),
+                      json.dumps(state).encode())
+
+    def resume(self, engine: BoxPSEngine, trainer) -> Optional[Dict]:
+        """Restore everything; returns the cursor dict or None if no ckpt."""
+        state_path = os.path.join(self.root, "STATE.json")
+        if not os.path.exists(state_path):
+            return None
+        with open(state_path) as f:
+            state = json.load(f)
+        engine.table.load(os.path.join(self.root, "sparse"))
+        engine.day_id = state.get("day_id")
+        engine.pass_id = state.get("pass_id", 0)
+        engine.phase = state.get("phase", 1)
+        with open(os.path.join(self.root, "dense.msgpack"), "rb") as f:
+            dense = serialization.from_bytes(
+                {"params": jax.device_get(trainer.params),
+                 "opt_state": jax.device_get(trainer.opt_state)},
+                f.read())
+        trainer.params = dense["params"]
+        trainer.opt_state = dense["opt_state"]
+        return state
+
+
+def save_xbox(engine: BoxPSEngine, path: str, base: bool = True) -> int:
+    """Serving-model dump (≙ the "xbox" base/delta format written by
+    SaveBase/SaveDelta, box_wrapper.cc:1286): one line per surviving
+    feature — key \\t show \\t click \\t embed_w \\t mf...  Quantization of
+    embedx (quant_bits) applies here when configured."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    acc = engine.config.accessor
+    qbits = engine.config.quant_bits
+    n = 0
+    with open(path, "w") as f:
+        for shard in engine.table._shards:
+            with shard.lock:
+                soa = shard.soa
+                score = engine.table._score(soa)
+                keep = (score >= acc.base_threshold) if base else \
+                    (np.abs(soa["delta_score"]) >= acc.delta_threshold)
+                idx = np.nonzero(keep)[0]
+                for i in idx:
+                    mf = soa["mf"][i]
+                    if qbits:
+                        scale = (1 << (qbits - 1)) - 1
+                        mf = np.round(mf * scale) / scale
+                    vals = " ".join(f"{v:.6g}" for v in mf)
+                    f.write(f"{shard.keys[i]}\t{soa['show'][i]:.6g}\t"
+                            f"{soa['click'][i]:.6g}\t"
+                            f"{soa['embed_w'][i]:.6g}\t{vals}\n")
+                    n += 1
+    return n
