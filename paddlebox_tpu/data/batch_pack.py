@@ -40,13 +40,18 @@ class PackedBatch:
 
 class BatchPacker:
     def __init__(self, feed_config: DataFeedConfig, batch_size: int,
-                 label_slot: str = "label"):
+                 label_slot="label"):
+        """label_slot: one slot name, or a list of names for multi-task
+        labels (labels output becomes [B, T])."""
         self.config = feed_config
         self.batch_size = batch_size
-        self.label_slot = label_slot
+        self.label_slots = ([label_slot] if isinstance(label_slot, str)
+                            else list(label_slot))
+        self.label_slot = self.label_slots[0]
         self.sparse_slots: List[SlotConfig] = feed_config.sparse_slots
         self.dense_slots: List[SlotConfig] = [
-            s for s in feed_config.dense_slots if s.name != label_slot]
+            s for s in feed_config.dense_slots
+            if s.name not in self.label_slots]
         self.capacity = max([s.capacity for s in self.sparse_slots] or [1])
         self.dense_dim = sum(s.dim for s in self.dense_slots)
 
@@ -90,15 +95,17 @@ class BatchPacker:
             dense[:n, col:col + slot.dim] = padded
             col += slot.dim
 
-        labels = np.zeros((B,), dtype=np.float32)
-        if self.label_slot in block.float_slots:
-            lv, lo = block.float_slots[self.label_slot]
-            lp, _ = self._pad_ragged(lv, lo, 1)
-            labels[:n] = lp[:, 0]
-        elif self.label_slot in block.uint64_slots:
-            lv, lo = block.uint64_slots[self.label_slot]
-            lp, _ = self._pad_ragged(lv, lo, 1)
-            labels[:n] = lp[:, 0].astype(np.float32)
+        multi = np.zeros((B, len(self.label_slots)), np.float32)
+        for t, name in enumerate(self.label_slots):
+            if name in block.float_slots:
+                lv, lo = block.float_slots[name]
+                lp, _ = self._pad_ragged(lv, lo, 1)
+                multi[:n, t] = lp[:, 0]
+            elif name in block.uint64_slots:
+                lv, lo = block.uint64_slots[name]
+                lp, _ = self._pad_ragged(lv, lo, 1)
+                multi[:n, t] = lp[:, 0].astype(np.float32)
+        labels = multi if len(self.label_slots) > 1 else multi[:, 0]
 
         valid = np.zeros((B,), dtype=bool)
         valid[:n] = True

@@ -1,0 +1,121 @@
+"""Multi-task trainer (MMoE path).
+
+The reference trains multi-task CTR models (MMoE/shared-bottom) with one
+metric set per task head (≙ multi-metric registry with name-keyed MetricMsg,
+box_wrapper.h:769-792).  Step differences vs SparseTrainer: labels are
+[B, T], the model exposes apply_multi → [B, T] logits, loss is the mean of
+per-task masked BCE, the instance's show/click for push use task 0 (the CTR
+head), and AUC accumulates per task into stacked bucket tables.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from paddlebox_tpu.data.batch_pack import BatchPacker
+from paddlebox_tpu.metrics.auc import AucCalculator, accumulate_auc
+from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
+from paddlebox_tpu.ps import embedding, optimizer as sparse_opt
+from paddlebox_tpu.trainer.trainer import SparseTrainer
+from paddlebox_tpu.utils.channel import Channel, ChannelClosed
+import threading
+
+
+def make_multi_auc_state(n_tasks: int, table_size: int):
+    return {
+        "pos": jnp.zeros((n_tasks, table_size), jnp.float32),
+        "neg": jnp.zeros((n_tasks, table_size), jnp.float32),
+        "scalars": jnp.zeros((n_tasks, 5), jnp.float32),
+    }
+
+
+class MultiTaskSparseTrainer(SparseTrainer):
+    def __init__(self, engine, model, feed_config, batch_size: int,
+                 label_slots: List[str], **kw):
+        super().__init__(engine, model, feed_config, batch_size,
+                         label_slot=label_slots[0], **kw)
+        self.label_slots = label_slots
+        self.n_tasks = len(label_slots)
+        self.packer = BatchPacker(feed_config, batch_size,
+                                  label_slot=label_slots)
+        self.auc_state = make_multi_auc_state(self.n_tasks,
+                                              self.auc_table_size)
+        self.task_aucs = [AucCalculator(self.auc_table_size)
+                          for _ in range(self.n_tasks)]
+
+    def _build_step(self):
+        sgd_cfg = self.engine.config.sgd
+        use_cvm = self.use_cvm
+        model = self.model
+        dense_tx = self.dense_tx
+        slot_ids = jnp.asarray(self.slot_ids)
+        n_tasks = self.n_tasks
+
+        def step(ws, params, opt_state, auc_state, indices, lengths, dense,
+                 labels, valid):
+            emb = jax.lax.stop_gradient(embedding.pull_sparse(ws, indices))
+            # show=1, click=task-0 label (the CTR head feeds the PS counters)
+            ins_cvm = jnp.stack(
+                [jnp.ones_like(labels[:, 0]), labels[:, 0]], axis=1)
+
+            def loss_fn(p, e):
+                pooled = fused_seqpool_cvm(e, lengths, ins_cvm, use_cvm)
+                logits = model.apply_multi(p, pooled, dense)  # [B, T]
+                w = valid.astype(jnp.float32)[:, None]
+                per = optax.sigmoid_binary_cross_entropy(logits, labels)
+                loss = jnp.sum(per * w) / jnp.maximum(jnp.sum(w) * n_tasks,
+                                                      1.0)
+                return loss, jax.nn.sigmoid(logits)
+
+            (loss, preds), (d_params, d_emb) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(params, emb)
+
+            acc = embedding.push_sparse_grads(ws, indices, d_emb, slot_ids)
+            ws = sparse_opt.apply_push(ws, acc, sgd_cfg)
+            updates, opt_state = dense_tx.update(d_params, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            def upd_task(t, st):
+                one = accumulate_auc(
+                    {"pos": st["pos"][t], "neg": st["neg"][t],
+                     "scalars": st["scalars"][t]},
+                    preds[:, t], labels[:, t], valid)
+                return {"pos": st["pos"].at[t].set(one["pos"]),
+                        "neg": st["neg"].at[t].set(one["neg"]),
+                        "scalars": st["scalars"].at[t].set(one["scalars"])}
+
+            for t in range(n_tasks):
+                auc_state = upd_task(t, auc_state)
+            return ws, params, opt_state, auc_state, loss, preds[:, 0]
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def _finalize_metrics(self, auc_state):
+        self.auc_state = auc_state
+        per_task = self.task_metrics()
+        out = dict(per_task[0])
+        for t, m in enumerate(per_task):
+            out[f"task{t}_auc"] = m["auc"]
+        return out
+
+    def task_metrics(self) -> List[Dict[str, float]]:
+        state = jax.device_get(self.auc_state)
+        out = []
+        for t in range(self.n_tasks):
+            calc = self.task_aucs[t]
+            calc.reset()
+            calc.merge_device_state({"pos": state["pos"][t],
+                                     "neg": state["neg"][t],
+                                     "scalars": state["scalars"][t]})
+            out.append(calc.compute())
+        return out
+
+    def reset_metrics(self):
+        self.auc_state = make_multi_auc_state(self.n_tasks,
+                                              self.auc_table_size)
+        self.auc.reset()

@@ -33,7 +33,8 @@ def test_fleet_pass_loop(data_file, tmp_path):
                             auc_table_size=10_000)
 
     aucs = []
-    for day, pas in [("20260701", 0), ("20260701", 1), ("20260702", 0)]:
+    for day, pas in [("20260701", 0), ("20260701", 1), ("20260702", 0),
+                     ("20260702", 1)]:
         dataset.set_date(day)
         dataset.load_into_memory()
         dataset.local_shuffle()

@@ -1,0 +1,74 @@
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import (DataFeedConfig, EmbeddingTableConfig,
+                                  SlotConfig, SparseSGDConfig)
+from paddlebox_tpu.data.dataset import SlotDataset
+from paddlebox_tpu.models.mmoe import MMoE
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.trainer.multitask import MultiTaskSparseTrainer
+
+MF = 4
+S = 2
+V = 40
+
+
+def cfg():
+    return DataFeedConfig(slots=(
+        SlotConfig("click", dtype="float", is_dense=True, dim=1),
+        SlotConfig("like", dtype="float", is_dense=True, dim=1),
+        SlotConfig("sa", slot_id=1, capacity=2),
+        SlotConfig("sb", slot_id=2, capacity=2),
+    ))
+
+
+def gen(path, n=1200, seed=0):
+    rng = np.random.default_rng(seed)
+    eff = rng.normal(0, 1.5, (S, V))
+    with open(path, "w") as f:
+        for _ in range(n):
+            ks = [rng.integers(1, V, rng.integers(1, 3)) for _ in range(S)]
+            score = sum(eff[s, k] for s, kk in enumerate(ks) for k in kk)
+            p1 = 1 / (1 + np.exp(-score))
+            p2 = 1 / (1 + np.exp(score))  # anti-correlated second task
+            l1 = int(rng.random() < p1)
+            l2 = int(rng.random() < p2)
+            parts = [f"1 {l1}", f"1 {l2}"]
+            for s, kk in enumerate(ks):
+                parts.append(f"{len(kk)} " +
+                             " ".join(str(s * 100 + k) for k in kk))
+            f.write(" ".join(parts) + "\n")
+
+
+def test_mmoe_multitask_trains(tmp_path):
+    data = str(tmp_path / "d.txt")
+    gen(data)
+    c = cfg()
+    engine = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=MF, shard_num=2,
+        sgd=SparseSGDConfig(mf_create_thresholds=1.0)))
+    model = MMoE(num_slots=S, emb_width=3 + MF, dense_dim=0,
+                 num_experts=3, num_tasks=2)
+    trainer = MultiTaskSparseTrainer(
+        engine, model, c, batch_size=128, label_slots=["click", "like"],
+        auc_table_size=5000)
+    ds = SlotDataset(c)
+    ds.set_filelist([data])
+    engine.attach_dataset(ds)
+
+    results = []
+    for _ in range(3):
+        engine.begin_feed_pass()
+        ds.load_into_memory()
+        ds.local_shuffle()
+        engine.end_feed_pass()
+        engine.begin_pass()
+        trainer.reset_metrics()
+        out = trainer.train_pass(ds)
+        engine.end_pass()
+        ds.release_memory()
+        results.append(out)
+    final = results[-1]
+    assert "task0_auc" in final and "task1_auc" in final
+    assert final["task0_auc"] > 0.62
+    assert final["task1_auc"] > 0.62
