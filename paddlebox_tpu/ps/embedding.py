@@ -163,8 +163,10 @@ def push_sparse_grads(ws: Dict[str, jnp.ndarray], indices: jnp.ndarray,
         "g_click": zeros.at[flat_idx].add(flat_g[:, 1]),
         "g_embed": zeros.at[flat_idx].add(flat_g[:, 2]),
         "g_embedx": jnp.zeros_like(ws["mf"]).at[flat_idx].add(flat_g[:, 3:]),
+        # only valid occurrences vote (the show grad column carries the
+        # seqpool key mask: ins_show > 0 exactly where the key is real)
         "slot": jnp.zeros((n,), jnp.int32).at[flat_idx].max(
-            flat_slot.astype(jnp.int32)),
+            jnp.where(flat_g[:, 0] > 0, flat_slot.astype(jnp.int32), 0)),
     }
     return acc
 

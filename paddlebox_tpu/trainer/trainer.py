@@ -46,7 +46,7 @@ class SparseTrainer:
                  topology: Optional[HybridTopology] = None,
                  auc_table_size: int = 100_000,
                  trainer_config: Optional[TrainerConfig] = None,
-                 amp: bool = False, seed: int = 0):
+                 amp: bool = False, fast_path: bool = True, seed: int = 0):
         self.engine = engine
         self.model = model
         self.packer = BatchPacker(feed_config, batch_size, label_slot)
@@ -55,6 +55,7 @@ class SparseTrainer:
         self.topology = topology
         self.trainer_config = trainer_config or TrainerConfig()
         self.amp = amp  # bf16 MXU compute for the dense net (master f32)
+        self.fast_path = fast_path  # tiling-aware pipeline (ps/fast_path.py)
         self.timers = TimerRegistry()
         self.slot_ids = np.array(
             [s.slot_id for s in feed_config.sparse_slots], np.int32)
@@ -77,6 +78,57 @@ class SparseTrainer:
 
     # ------------------------------------------------------------------
     def _build_step(self):
+        if self.fast_path:
+            return self._build_step_fast()
+        return self._build_step_reference()
+
+    def _build_step_fast(self):
+        """Tiling-aware step (see ps/fast_path.py docstring); numerically
+        identical to the reference step — tests/test_fast_path.py."""
+        from paddlebox_tpu.ps import fast_path
+        sgd_cfg = self.engine.config.sgd
+        use_cvm = self.use_cvm
+        model = self.model
+        dense_tx = self.dense_tx
+        amp = self.amp
+        slot_ids = jnp.asarray(self.slot_ids)
+
+        def step(ws, params, opt_state, auc_state, indices, lengths, dense,
+                 labels, valid):
+            idx = jnp.transpose(indices, (0, 2, 1))        # [S, L, B]
+            pooled = jax.lax.stop_gradient(
+                fast_path.pull_pool_cvm(ws, idx, lengths, use_cvm))
+            ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
+            B, S, E = pooled.shape
+
+            def loss_fn(p, pooled_in):
+                x = pooled_in if use_cvm else pooled_in[:, :, 2:]
+                x = x.reshape(B, -1)
+                if amp:
+                    p_c = jax.tree.map(lambda a: a.astype(jnp.bfloat16), p)
+                    logits = model.apply(
+                        p_c, x.astype(jnp.bfloat16),
+                        dense.astype(jnp.bfloat16)).astype(jnp.float32)
+                else:
+                    logits = model.apply(p, x, dense)
+                w = valid.astype(jnp.float32)
+                per = optax.sigmoid_binary_cross_entropy(logits, labels)
+                loss = jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
+                return loss, jax.nn.sigmoid(logits)
+
+            (loss, preds), (d_params, d_pooled) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(params, pooled)
+
+            ws = fast_path.push_and_update(ws, idx, lengths, d_pooled,
+                                           ins_cvm, slot_ids, sgd_cfg)
+            updates, opt_state = dense_tx.update(d_params, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            auc_state = accumulate_auc(auc_state, preds, labels, valid)
+            return ws, params, opt_state, auc_state, loss, preds
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def _build_step_reference(self):
         sgd_cfg = self.engine.config.sgd
         use_cvm = self.use_cvm
         model = self.model
