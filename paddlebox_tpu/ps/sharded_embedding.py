@@ -1,0 +1,66 @@
+"""Explicit cross-chip embedding exchange — the HeterComm equivalent.
+
+≙ HeterComm's sharded pull/push (heter_comm_inl.h): split_input_to_shard
+(:1117, key % device_count), walk_to_dest/walk_to_src P2P hops (:303,316),
+merged gradient push (:1730) and the inter-node allgather (:2027,2131).
+
+TPU-first redesign inside shard_map over the table axis:
+* the pass working set is row-sharded in CONTIGUOUS blocks (device d owns
+  rows [d*rows_loc, (d+1)*rows_loc)) — owner = row // rows_loc, no hash;
+* pull: all_gather the batch's row ids (ids are tiny vs values), each
+  device gathers the rows it owns (masked), and one reduce_scatter returns
+  exactly the requesting device's slice — two ICI collectives replacing the
+  reference's per-pair cudaMemcpyPeer walks;
+* push: the transpose — all_gather the grads' target ids + values?  No:
+  grads all_gather is the reduce_scatter transpose, so we all_gather the
+  (ids, grad) pairs and every device scatter-adds the rows it owns locally
+  (≙ gather_one_node_grad's allgather + local merge, heter_comm_inl.h:2027).
+
+Use when GSPMD's automatic layout of `table[idx]` is not wanted; the pjit
+path (embedding.py + HybridTopology.table_spec) remains the default.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pull_rows_sharded(table_local: jnp.ndarray, idx_local: jnp.ndarray,
+                      axis: str) -> jnp.ndarray:
+    """Inside shard_map.  table_local: [rows_loc, D] (this device's block of
+    the [N, D] table); idx_local: [P_loc] global row ids needed by this
+    device's batch shard.  → [P_loc, D]."""
+    n_dev = lax.axis_size(axis)
+    rows_loc = table_local.shape[0]
+    me = lax.axis_index(axis)
+    # 1. everyone learns everyone's requests (ids only — cheap)
+    idx_all = lax.all_gather(idx_local, axis, axis=0, tiled=True)  # [P]
+    # 2. gather the rows I own; zeros elsewhere
+    local = idx_all - me * rows_loc
+    mine = (local >= 0) & (local < rows_loc)
+    vals = table_local[jnp.clip(local, 0, rows_loc - 1)] \
+        * mine[:, None].astype(table_local.dtype)          # [P, D]
+    # 3. sum over devices, returning each requester its slice
+    return lax.psum_scatter(vals, axis, scatter_dimension=0, tiled=True)
+
+
+def push_rows_sharded(table_local: jnp.ndarray, idx_local: jnp.ndarray,
+                      grads_local: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Scatter-add grads into the row-sharded table (merge-by-key lands on
+    the owner, ≙ push_sparse_multi_node).  grads_local: [P_loc, D]."""
+    n_dev = lax.axis_size(axis)
+    rows_loc = table_local.shape[0]
+    me = lax.axis_index(axis)
+    idx_all = lax.all_gather(idx_local, axis, axis=0, tiled=True)   # [P]
+    g_all = lax.all_gather(grads_local, axis, axis=0, tiled=True)   # [P, D]
+    local = idx_all - me * rows_loc
+    mine = (local >= 0) & (local < rows_loc)
+    safe = jnp.where(mine, local, 0)
+    g_masked = g_all * mine[:, None].astype(g_all.dtype)
+    # row 0 of device 0 is the global reserved row; non-owned writes go to
+    # local row 0 with zero grads, so they are no-ops
+    return table_local.at[safe].add(g_masked)
