@@ -1,0 +1,30 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.ops.pallas_gather import gather_pool, ROW_BLOCK
+
+
+def golden(table, idx, lengths):
+    R, L = idx.shape
+    out = np.zeros((R, table.shape[1]), table.dtype)
+    for r in range(R):
+        for l in range(int(lengths[r])):
+            out[r] += table[idx[r, l]]
+    return out
+
+
+@pytest.mark.parametrize("L", [1, 3])
+def test_gather_pool_interpret(L):
+    rng = np.random.default_rng(0)
+    N, D = 512, 8
+    R = ROW_BLOCK * 2
+    table = rng.normal(0, 1, (N, D)).astype(np.float32)
+    table[0] = 0.0
+    idx = rng.integers(0, N, (R, L)).astype(np.int32)
+    lengths = rng.integers(0, L + 1, (R,)).astype(np.int32)
+    got = gather_pool(jnp.asarray(table), jnp.asarray(idx),
+                      jnp.asarray(lengths), interpret=True)
+    np.testing.assert_allclose(np.asarray(got), golden(table, idx, lengths),
+                               rtol=1e-5, atol=1e-6)
