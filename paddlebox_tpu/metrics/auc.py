@@ -165,10 +165,26 @@ class MetricGroup:
 
     def init_metric(self, name: str, label_var: str = "label",
                     pred_var: str = "prob", phase: int = -1,
+                    cmatch_rank_group: str = "", ignore_rank: bool = False,
                     table_size: int = TABLE_SIZE) -> None:
+        """cmatch_rank_group: "222:1,223:2" keeps records whose
+        (cmatch, rank) is listed; "222,223" (or ignore_rank) filters on
+        cmatch only (≙ CmatchRankAucCalculator / MetricMsg variants,
+        metrics.h:204+)."""
+        pairs = []
+        for tok in cmatch_rank_group.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if ":" in tok and not ignore_rank:
+                c, r = tok.split(":")
+                pairs.append((int(c), int(r)))
+            else:
+                pairs.append((int(tok.split(":")[0]), None))
         self._metrics[name] = {
             "calc": AucCalculator(table_size),
             "label_var": label_var, "pred_var": pred_var, "phase": phase,
+            "cmatch_rank": pairs,
         }
 
     def flip_phase(self) -> None:
@@ -178,8 +194,24 @@ class MetricGroup:
         return [n for n, m in self._metrics.items()
                 if m["phase"] in (-1, self.phase)]
 
-    def update(self, name: str, pred, label, mask=None) -> None:
-        self._metrics[name]["calc"].add_data(pred, label, mask)
+    def update(self, name: str, pred, label, mask=None,
+               cmatch=None, rank=None) -> None:
+        """mask/cmatch/rank filtering (≙ add_mask_data metrics.cc:164 and
+        the cmatch_rank MetricMsg update loop)."""
+        m = self._metrics[name]
+        pred = np.asarray(pred)
+        keep = np.ones(len(pred), bool) if mask is None else \
+            np.asarray(mask, bool).copy()
+        if m["cmatch_rank"]:
+            cm = np.asarray(cmatch) if cmatch is not None else \
+                np.zeros(len(pred), np.int64)
+            rk = np.asarray(rank) if rank is not None else \
+                np.zeros(len(pred), np.int64)
+            sel = np.zeros(len(pred), bool)
+            for c, r in m["cmatch_rank"]:
+                sel |= (cm == c) if r is None else ((cm == c) & (rk == r))
+            keep &= sel
+        m["calc"].add_data(pred, label, keep)
 
     def merge_device_state(self, name: str, state) -> None:
         self._metrics[name]["calc"].merge_device_state(state)

@@ -1,0 +1,49 @@
+"""Alias-method discrete sampling (≙ operators/alias_method_op.{cc,cu,h}:
+Walker's alias method for O(1) draws from a discrete distribution — used by
+PaddleBox models for negative sampling).
+
+TPU-first split: the alias table build is host-side numpy (once per
+distribution change); sampling is a jit-able two-gather + select, so it runs
+inside the train step at full vector width.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def build_alias_table(probs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """probs [K] (unnormalized ok) → (accept [K] f32, alias [K] i32)."""
+    p = np.asarray(probs, np.float64)
+    p = p / p.sum()
+    K = len(p)
+    accept = np.zeros(K, np.float32)
+    alias = np.zeros(K, np.int32)
+    scaled = p * K
+    small = [i for i in range(K) if scaled[i] < 1.0]
+    large = [i for i in range(K) if scaled[i] >= 1.0]
+    while small and large:
+        s = small.pop()
+        l = large.pop()
+        accept[s] = scaled[s]
+        alias[s] = l
+        scaled[l] = scaled[l] - (1.0 - scaled[s])
+        (small if scaled[l] < 1.0 else large).append(l)
+    for i in large + small:
+        accept[i] = 1.0
+        alias[i] = i
+    return accept, alias
+
+
+def alias_sample(key, accept: jnp.ndarray, alias: jnp.ndarray,
+                 shape: Tuple[int, ...]) -> jnp.ndarray:
+    """Draw samples ~ the distribution encoded by (accept, alias)."""
+    K = accept.shape[0]
+    k1, k2 = jax.random.split(key)
+    col = jax.random.randint(k1, shape, 0, K)
+    u = jax.random.uniform(k2, shape)
+    return jnp.where(u < accept[col], col, alias[col]).astype(jnp.int32)

@@ -48,11 +48,25 @@ EXPAND_FIELDS = (
     ("mf_ex_g2sum", np.float32, ()),
 )
 
+# extra per-row state for the (shared-)adam optimizers: shared first/second
+# moments + beta-power trackers for the embed and embedx groups
+# (≙ SparseAdamSharedOptimizer state layout, optimizer.cuh.h:455-467:
+# GSum/G2Sum/Beta1Pow/Beta2Pow — here G2Sum reuses embed_g2sum/mf_g2sum)
+ADAM_FIELDS = (
+    ("embed_gsum", np.float32, ()),
+    ("embed_b1p", np.float32, ()),
+    ("embed_b2p", np.float32, ()),
+    ("mf_gsum", np.float32, ()),
+    ("mf_b1p", np.float32, ()),
+    ("mf_b2p", np.float32, ()),
+)
 
-def empty_soa(n: int, mf_dim: int, expand_dim: int = 0
+
+def empty_soa(n: int, mf_dim: int, expand_dim: int = 0, adam: bool = False
               ) -> Dict[str, np.ndarray]:
     out = {}
-    fields = HOST_FIELDS + (EXPAND_FIELDS if expand_dim > 0 else ())
+    fields = HOST_FIELDS + (EXPAND_FIELDS if expand_dim > 0 else ()) \
+        + (ADAM_FIELDS if adam else ())
     for name, dtype, suffix in fields:
         shape = (n,) + tuple(
             mf_dim if s == "D" else (expand_dim if s == "E" else s)
@@ -63,7 +77,9 @@ def empty_soa(n: int, mf_dim: int, expand_dim: int = 0
 
 def default_rows(n: int, mf_dim: int, rng: np.random.Generator,
                  mf_initial_range: float, initial_range: float = 0.0,
-                 expand_dim: int = 0) -> Dict[str, np.ndarray]:
+                 expand_dim: int = 0, adam: bool = False,
+                 beta1: float = 0.9, beta2: float = 0.999
+                 ) -> Dict[str, np.ndarray]:
     """Fresh feature rows for keys unseen by the host table.
 
     embed_w ~ U(-initial_range, initial_range) (CPU rule init; default range 0
@@ -71,7 +87,7 @@ def default_rows(n: int, mf_dim: int, rng: np.random.Generator,
     ~ U(0, mf_initial_range) (≙ curand_uniform * mf_initial_range,
     optimizer.cuh.h:119-121) which stays masked until mf_size > 0.
     """
-    soa = empty_soa(n, mf_dim, expand_dim)
+    soa = empty_soa(n, mf_dim, expand_dim, adam)
     if initial_range > 0:
         soa["embed_w"] = rng.uniform(
             -initial_range, initial_range, size=(n,)).astype(np.float32)
@@ -80,6 +96,13 @@ def default_rows(n: int, mf_dim: int, rng: np.random.Generator,
     if expand_dim > 0:
         soa["mf_ex"] = rng.uniform(
             0.0, mf_initial_range, size=(n, expand_dim)).astype(np.float32)
+    if adam:
+        # fresh features start their beta-power trackers at the decay rates
+        # (≙ creation init optimizer.cuh.h:436-441 / adam accessor InitValue)
+        soa["embed_b1p"][:] = beta1
+        soa["embed_b2p"][:] = beta2
+        soa["mf_b1p"][:] = beta1
+        soa["mf_b2p"][:] = beta2
     return soa
 
 

@@ -25,9 +25,9 @@ from paddlebox_tpu.ps import feature_value as fv
 
 
 class _Shard:
-    def __init__(self, mf_dim: int, expand_dim: int = 0):
+    def __init__(self, mf_dim: int, expand_dim: int = 0, adam: bool = False):
         self.keys = np.empty((0,), np.uint64)
-        self.soa = fv.empty_soa(0, mf_dim, expand_dim)
+        self.soa = fv.empty_soa(0, mf_dim, expand_dim, adam)
         self.mf_dim = mf_dim
         self.lock = threading.Lock()
 
@@ -71,8 +71,9 @@ class ShardedHostTable:
         self.config = config
         self.mf_dim = config.embedding_dim
         self.expand_dim = config.expand_dim
+        self.adam = config.sgd.optimizer in ("adam", "shared_adam")
         self.shard_num = config.shard_num
-        self._shards = [_Shard(self.mf_dim, self.expand_dim)
+        self._shards = [_Shard(self.mf_dim, self.expand_dim, self.adam)
                         for _ in range(self.shard_num)]
         self._rng = np.random.default_rng(seed)
 
@@ -92,7 +93,9 @@ class ShardedHostTable:
         out = fv.default_rows(n, self.mf_dim, self._rng,
                               self.config.sgd.mf_initial_range,
                               self.config.sgd.initial_range,
-                              self.expand_dim)
+                              self.expand_dim, self.adam,
+                              self.config.sgd.beta1_decay_rate,
+                              self.config.sgd.beta2_decay_rate)
         sid = self._shard_ids(keys)
         for s, shard in enumerate(self._shards):
             sel = np.nonzero(sid == s)[0]

@@ -112,15 +112,46 @@ def sparse_adagrad_apply(ws: Dict[str, jnp.ndarray],
     return out
 
 
+def _shared_adam_group(w, m1, m2, b1p, b2p, g, scale, lr, beta1, beta2,
+                       min_bound, max_bound, touched, n_dim: int):
+    """≙ SparseAdamSharedOptimizer::update_value_work
+    (optimizer.cuh.h:341-386): ONE shared (moment1, moment2, beta-pow) per
+    row for the whole group; per-dim new moments derive from the shared old
+    moment, updated w per dim, then the stored moments are the per-dim
+    means and the beta powers decay once."""
+    eps = 1e-8
+    safe_scale = jnp.where(scale > 0, scale, 1.0)
+    ratio = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    if w.ndim == 2:
+        sg = g / safe_scale[:, None]
+        new_m1 = beta1 * m1[:, None] + (1 - beta1) * sg
+        new_m2 = beta2 * m2[:, None] + (1 - beta2) * sg * sg
+        new_w = w + ratio[:, None] * (new_m1 / (jnp.sqrt(new_m2) + eps))
+        m1_out = jnp.mean(new_m1, axis=1)
+        m2_out = jnp.mean(new_m2, axis=1)
+        mask = touched[:, None]
+    else:
+        sg = g / safe_scale
+        new_m1 = beta1 * m1 + (1 - beta1) * sg
+        new_m2 = beta2 * m2 + (1 - beta2) * sg * sg
+        new_w = w + ratio * (new_m1 / (jnp.sqrt(new_m2) + eps))
+        m1_out, m2_out = new_m1, new_m2
+        mask = touched
+    new_w = jnp.clip(new_w, min_bound, max_bound)
+    return (jnp.where(mask, new_w, w),
+            jnp.where(touched, m1_out, m1),
+            jnp.where(touched, m2_out, m2),
+            jnp.where(touched, b1p * beta1, b1p),
+            jnp.where(touched, b2p * beta2, b2p))
+
+
 def sparse_adam_apply(ws: Dict[str, jnp.ndarray], acc: Dict[str, jnp.ndarray],
                       cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
-    """SparseAdamShared-style update (optimizer.cuh.h:330): shared scalar
-    moments per row (beta1/beta2 powers folded into g2sum-like slots).
-
-    Round-1 scope: moments stored in embed_g2sum/mf_g2sum as EMA of squared
-    grads (RMSProp-flavored shared-adam); exact beta-power tracking needs two
-    extra [N] slots — planned alongside the adam accessor.
-    """
+    """Exact SparseAdamShared (optimizer.cuh.h:330-477): shared per-row
+    moments in embed_gsum/embed_g2sum (+ beta powers) for the lr weight and
+    mf_gsum/mf_g2sum for the embedx group.  Requires the adam state fields
+    (feature_value.ADAM_FIELDS — created when config.sgd.optimizer is
+    adam/shared_adam)."""
     n = ws["show"].shape[0]
     row = jnp.arange(n)
     touched = (acc["g_show"] > 0) & (row != 0)
@@ -132,16 +163,11 @@ def sparse_adam_apply(ws: Dict[str, jnp.ndarray], acc: Dict[str, jnp.ndarray],
         + cfg.clk_coeff * acc["g_click"],
         ws["delta_score"])
 
-    safe_scale = jnp.where(acc["g_show"] > 0, acc["g_show"], 1.0)
-    b2 = cfg.beta2_decay_rate
-    sg = acc["g_embed"] / safe_scale
-    v = jnp.where(touched, b2 * ws["embed_g2sum"] + (1 - b2) * sg * sg,
-                  ws["embed_g2sum"])
-    new_embed = ws["embed_w"] + cfg.learning_rate * sg / \
-        (jnp.sqrt(v) + cfg.ada_epsilon)
-    embed_w = jnp.where(touched,
-                        jnp.clip(new_embed, cfg.min_bound, cfg.max_bound),
-                        ws["embed_w"])
+    embed_w, e_m1, e_m2, e_b1, e_b2 = _shared_adam_group(
+        ws["embed_w"], ws["embed_gsum"], ws["embed_g2sum"],
+        ws["embed_b1p"], ws["embed_b2p"], acc["g_embed"], acc["g_show"],
+        cfg.learning_rate, cfg.beta1_decay_rate, cfg.beta2_decay_rate,
+        cfg.mf_min_bound, cfg.mf_max_bound, touched, 1)
 
     mf_dim = ws["mf"].shape[1]
     score = cfg.nonclk_coeff * (show - click) + cfg.clk_coeff * click
@@ -149,20 +175,65 @@ def sparse_adam_apply(ws: Dict[str, jnp.ndarray], acc: Dict[str, jnp.ndarray],
         (score >= cfg.mf_create_thresholds)
     mf_size = jnp.where(create, mf_dim, ws["mf_size"])
     mf_touched = touched & (ws["mf_size"] > 0)
-    sgx = acc["g_embedx"] / safe_scale[:, None]
-    vx = jnp.where(mf_touched,
-                   b2 * ws["mf_g2sum"] + (1 - b2) * jnp.mean(sgx * sgx, 1),
-                   ws["mf_g2sum"])
-    new_mf = ws["mf"] + cfg.mf_learning_rate * sgx / \
-        (jnp.sqrt(vx)[:, None] + cfg.ada_epsilon)
-    mf = jnp.where(mf_touched[:, None],
-                   jnp.clip(new_mf, cfg.mf_min_bound, cfg.mf_max_bound),
-                   ws["mf"])
+    mf, m_m1, m_m2, m_b1, m_b2 = _shared_adam_group(
+        ws["mf"], ws["mf_gsum"], ws["mf_g2sum"], ws["mf_b1p"], ws["mf_b2p"],
+        acc["g_embedx"], acc["g_show"], cfg.mf_learning_rate,
+        cfg.beta1_decay_rate, cfg.beta2_decay_rate,
+        cfg.mf_min_bound, cfg.mf_max_bound, mf_touched, mf_dim)
+    # rows created this push reset their beta powers to the decay rates
+    # (creation init, optimizer.cuh.h:436-441)
+    m_b1 = jnp.where(create, cfg.beta1_decay_rate, m_b1)
+    m_b2 = jnp.where(create, cfg.beta2_decay_rate, m_b2)
 
     out = {"show": show, "click": click, "delta_score": delta,
            "slot": jnp.where(touched, acc["slot"], ws["slot"]),
-           "embed_w": embed_w, "embed_g2sum": v,
-           "mf_size": mf_size, "mf_g2sum": vx, "mf": mf}
+           "embed_w": embed_w, "embed_g2sum": e_m2, "embed_gsum": e_m1,
+           "embed_b1p": e_b1, "embed_b2p": e_b2,
+           "mf_size": mf_size, "mf_g2sum": m_m2, "mf_gsum": m_m1,
+           "mf_b1p": m_b1, "mf_b2p": m_b2, "mf": mf}
+    for extra in ("mf_ex", "mf_ex_g2sum"):
+        if extra in ws:
+            out[extra] = ws[extra]
+    return out
+
+
+def sparse_naive_apply(ws: Dict[str, jnp.ndarray],
+                       acc: Dict[str, jnp.ndarray],
+                       cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
+    """SparseNaiveSGDRule (sparse_sgd_rule.h:77): plain SGD with bound
+    clipping, show-scaled grads; g2sum fields unused."""
+    n = ws["show"].shape[0]
+    row = jnp.arange(n)
+    touched = (acc["g_show"] > 0) & (row != 0)
+    show = jnp.where(touched, ws["show"] + acc["g_show"], ws["show"])
+    click = jnp.where(touched, ws["click"] + acc["g_click"], ws["click"])
+    delta = jnp.where(
+        touched,
+        ws["delta_score"] + cfg.nonclk_coeff * (acc["g_show"] - acc["g_click"])
+        + cfg.clk_coeff * acc["g_click"],
+        ws["delta_score"])
+    safe_scale = jnp.where(acc["g_show"] > 0, acc["g_show"], 1.0)
+    embed_w = jnp.where(
+        touched,
+        jnp.clip(ws["embed_w"] + cfg.learning_rate *
+                 acc["g_embed"] / safe_scale, cfg.min_bound, cfg.max_bound),
+        ws["embed_w"])
+    mf_dim = ws["mf"].shape[1]
+    score = cfg.nonclk_coeff * (show - click) + cfg.clk_coeff * click
+    create = touched & (ws["mf_size"] == 0) & \
+        (score >= cfg.mf_create_thresholds)
+    mf_size = jnp.where(create, mf_dim, ws["mf_size"])
+    mf_touched = touched & (ws["mf_size"] > 0)
+    mf = jnp.where(
+        mf_touched[:, None],
+        jnp.clip(ws["mf"] + cfg.mf_learning_rate *
+                 acc["g_embedx"] / safe_scale[:, None],
+                 cfg.mf_min_bound, cfg.mf_max_bound),
+        ws["mf"])
+    out = {"show": show, "click": click, "delta_score": delta,
+           "slot": jnp.where(touched, acc["slot"], ws["slot"]),
+           "embed_w": embed_w, "embed_g2sum": ws["embed_g2sum"],
+           "mf_size": mf_size, "mf_g2sum": ws["mf_g2sum"], "mf": mf}
     for extra in ("mf_ex", "mf_ex_g2sum"):
         if extra in ws:
             out[extra] = ws[extra]
@@ -173,6 +244,7 @@ OPTIMIZERS = {
     "adagrad": sparse_adagrad_apply,
     "shared_adam": sparse_adam_apply,
     "adam": sparse_adam_apply,
+    "naive": sparse_naive_apply,
 }
 
 

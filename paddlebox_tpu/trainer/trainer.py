@@ -78,7 +78,9 @@ class SparseTrainer:
 
     # ------------------------------------------------------------------
     def _build_step(self):
-        if self.fast_path:
+        # the fast path implements the adagrad rule only; other optimizers
+        # take the reference path
+        if self.fast_path and self.engine.config.sgd.optimizer == "adagrad":
             return self._build_step_fast()
         return self._build_step_reference()
 

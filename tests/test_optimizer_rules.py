@@ -1,0 +1,120 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+from paddlebox_tpu.metrics.auc import MetricGroup
+from paddlebox_tpu.ops.alias_method import alias_sample, build_alias_table
+from paddlebox_tpu.ps import optimizer
+from paddlebox_tpu.ps.host_table import ShardedHostTable
+import jax
+
+
+def make_ws_adam(n=3, d=2):
+    ws = {
+        "show": jnp.array([0., 4., 2.]), "click": jnp.array([0., 1., 0.]),
+        "delta_score": jnp.zeros(n), "slot": jnp.zeros(n, jnp.int32),
+        "embed_w": jnp.array([0., 0.3, -0.2]),
+        "embed_g2sum": jnp.zeros(n), "embed_gsum": jnp.zeros(n),
+        "embed_b1p": jnp.full(n, 0.9), "embed_b2p": jnp.full(n, 0.999),
+        "mf_size": jnp.array([0, d, 0], jnp.int32),
+        "mf_g2sum": jnp.zeros(n), "mf_gsum": jnp.zeros(n),
+        "mf_b1p": jnp.full(n, 0.9), "mf_b2p": jnp.full(n, 0.999),
+        "mf": jnp.array([[0., 0.], [.5, .6], [.01, .02]]),
+    }
+    return ws
+
+
+def ref_shared_adam_scalar(cfg, w, m1, m2, b1p, b2p, g, scale):
+    """Scalar golden of update_value_work (optimizer.cuh.h:341-386), n=1."""
+    eps = 1e-8
+    ratio = cfg.learning_rate * np.sqrt(1 - b2p) / (1 - b1p)
+    sg = g / scale
+    nm1 = cfg.beta1_decay_rate * m1 + (1 - cfg.beta1_decay_rate) * sg
+    nm2 = cfg.beta2_decay_rate * m2 + (1 - cfg.beta2_decay_rate) * sg * sg
+    w2 = np.clip(w + ratio * nm1 / (np.sqrt(nm2) + eps),
+                 cfg.mf_min_bound, cfg.mf_max_bound)
+    return w2, nm1, nm2, b1p * cfg.beta1_decay_rate, \
+        b2p * cfg.beta2_decay_rate
+
+
+def test_shared_adam_matches_scalar_golden():
+    cfg = SparseSGDConfig(optimizer="shared_adam")
+    ws = make_ws_adam()
+    acc = {
+        "g_show": jnp.array([0., 2., 0.]),
+        "g_click": jnp.array([0., 1., 0.]),
+        "g_embed": jnp.array([0., 0.4, 0.]),
+        "g_embedx": jnp.array([[0., 0.], [0.2, -0.2], [0., 0.]]),
+        "slot": jnp.array([0, 5, 0], jnp.int32),
+    }
+    out = optimizer.sparse_adam_apply(ws, acc, cfg)
+    w2, m1, m2, b1, b2 = ref_shared_adam_scalar(
+        cfg, 0.3, 0.0, 0.0, 0.9, 0.999, 0.4, 2.0)
+    assert np.isclose(float(out["embed_w"][1]), w2, rtol=1e-6)
+    assert np.isclose(float(out["embed_gsum"][1]), m1, rtol=1e-6)
+    assert np.isclose(float(out["embed_g2sum"][1]), m2, rtol=1e-6)
+    assert np.isclose(float(out["embed_b1p"][1]), b1)
+    # mf group: shared moments are the per-dim means
+    eps = 1e-8
+    ratio = cfg.mf_learning_rate * np.sqrt(1 - 0.999) / (1 - 0.9)
+    sg = np.array([0.2, -0.2]) / 2.0
+    nm1 = 0.9 * 0.0 + 0.1 * sg
+    nm2 = 0.999 * 0.0 + 0.001 * sg * sg
+    want_mf = np.clip(np.array([.5, .6]) + ratio * nm1 /
+                      (np.sqrt(nm2) + eps),
+                      cfg.mf_min_bound, cfg.mf_max_bound)
+    np.testing.assert_allclose(np.asarray(out["mf"][1]), want_mf, rtol=1e-5)
+    assert np.isclose(float(out["mf_gsum"][1]), nm1.mean(), rtol=1e-6)
+    # untouched rows unchanged
+    assert float(out["embed_b1p"][2]) == pytest.approx(0.9)
+
+
+def test_naive_rule():
+    cfg = SparseSGDConfig(optimizer="naive", learning_rate=0.1)
+    n = 2
+    ws = {
+        "show": jnp.zeros(n), "click": jnp.zeros(n),
+        "delta_score": jnp.zeros(n), "slot": jnp.zeros(n, jnp.int32),
+        "embed_w": jnp.zeros(n), "embed_g2sum": jnp.zeros(n),
+        "mf_size": jnp.zeros(n, jnp.int32), "mf_g2sum": jnp.zeros(n),
+        "mf": jnp.zeros((n, 2)),
+    }
+    acc = {"g_show": jnp.array([0., 1.]), "g_click": jnp.array([0., 0.]),
+           "g_embed": jnp.array([0., 0.5]),
+           "g_embedx": jnp.zeros((n, 2)),
+           "slot": jnp.zeros(n, jnp.int32)}
+    out = optimizer.sparse_naive_apply(ws, acc, cfg)
+    assert np.isclose(float(out["embed_w"][1]), 0.05)
+
+
+def test_host_table_adam_fields():
+    t = ShardedHostTable(EmbeddingTableConfig(
+        embedding_dim=2, shard_num=2,
+        sgd=SparseSGDConfig(optimizer="shared_adam")))
+    rows = t.bulk_pull(np.array([5], np.uint64))
+    assert "embed_b1p" in rows and rows["embed_b1p"][0] == np.float32(0.9)
+    assert rows["mf_b2p"][0] == np.float32(0.999)
+
+
+def test_metric_cmatch_rank_slicing():
+    g = MetricGroup()
+    g.init_metric("q_auc", cmatch_rank_group="222:1,223")
+    pred = [0.1, 0.9, 0.8, 0.2, 0.7]
+    label = [0, 1, 1, 0, 1]
+    cmatch = [222, 222, 223, 222, 500]
+    rank = [1, 2, 7, 1, 1]
+    # kept: idx0 (222:1), idx2 (223 any rank), idx3 (222:1)
+    g.update("q_auc", pred, label, cmatch=cmatch, rank=rank)
+    out = g.get_metric_msg("q_auc")
+    assert out["size"] == 3
+    assert out["auc"] == 1.0  # 0.8 positive vs 0.1/0.2 negatives
+
+
+def test_alias_method():
+    probs = np.array([0.1, 0.2, 0.3, 0.4])
+    accept, alias = build_alias_table(probs)
+    samples = alias_sample(jax.random.PRNGKey(0), jnp.asarray(accept),
+                           jnp.asarray(alias), (200_000,))
+    freq = np.bincount(np.asarray(samples), minlength=4) / 200_000
+    np.testing.assert_allclose(freq, probs, atol=0.01)
