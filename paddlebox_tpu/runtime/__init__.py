@@ -1,0 +1,3 @@
+from paddlebox_tpu.runtime.fleet_executor import (  # noqa: F401
+    Carrier, ComputeInterceptor, FleetExecutor, Interceptor, Message,
+    MessageBus, SinkInterceptor, SourceInterceptor, TaskNode)

@@ -1,0 +1,67 @@
+import pytest
+
+from paddlebox_tpu.runtime.fleet_executor import (Carrier, FleetExecutor,
+                                                  Message, MessageBus,
+                                                  TaskNode)
+
+
+def test_linear_pipeline_dag():
+    nodes = [
+        TaskNode(0, "source", downstream=[1], max_runs=10),
+        TaskNode(1, "compute", upstream=[0], downstream=[2],
+                 fn=lambda x: x * 2),
+        TaskNode(2, "compute", upstream=[1], downstream=[3],
+                 fn=lambda x: x + 1),
+        TaskNode(3, "sink", upstream=[2]),
+    ]
+    out = FleetExecutor(nodes, source_generator=lambda i: i).run()
+    assert out == [i * 2 + 1 for i in range(10)]
+
+
+def test_diamond_dag_joins_inputs():
+    nodes = [
+        TaskNode(0, "source", downstream=[1, 2], max_runs=6),
+        TaskNode(1, "compute", upstream=[0], downstream=[3],
+                 fn=lambda x: x * 10),
+        TaskNode(2, "compute", upstream=[0], downstream=[3],
+                 fn=lambda x: x + 3),
+        TaskNode(3, "compute", upstream=[1, 2], downstream=[4],
+                 fn=lambda a, b: a + b),
+        TaskNode(4, "sink", upstream=[3]),
+    ]
+    out = FleetExecutor(nodes, source_generator=lambda i: i).run()
+    assert out == [i * 10 + i + 3 for i in range(6)]
+
+
+def test_amplifier_fans_out():
+    nodes = [
+        TaskNode(0, "source", downstream=[1], max_runs=3),
+        TaskNode(1, "amplifier", upstream=[0], downstream=[2],
+                 amplify=2, buffer_size=8),
+        TaskNode(2, "sink", upstream=[1], buffer_size=8),
+    ]
+    out = FleetExecutor(nodes, source_generator=lambda i: i).run()
+    assert sorted(out) == [0, 0, 1, 1, 2, 2]
+
+
+def test_cross_carrier_bus():
+    """Two carriers on one bus, tasks split across them."""
+    bus = MessageBus()
+    task_rank = {0: 0, 1: 1, 2: 0}
+    c0 = Carrier(rank=0, bus=bus, task_rank=task_rank)
+    c1 = Carrier(rank=1, bus=bus, task_rank=task_rank)
+    from paddlebox_tpu.runtime.fleet_executor import (ComputeInterceptor,
+                                                      SinkInterceptor,
+                                                      SourceInterceptor)
+    n0 = TaskNode(0, "source", downstream=[1], max_runs=5)
+    n1 = TaskNode(1, "compute", upstream=[0], downstream=[2],
+                  fn=lambda x: x ** 2)
+    n2 = TaskNode(2, "sink", upstream=[1])
+    c0.add(SourceInterceptor(n0, c0, lambda i: i))
+    c1.add(ComputeInterceptor(n1, c1))
+    sink = SinkInterceptor(n2, c0)
+    c0.add(sink)
+    c1.run()
+    c0.run()
+    assert c0.wait(30)
+    assert [p for _, p in sorted(sink.results)] == [0, 1, 4, 9, 16]
