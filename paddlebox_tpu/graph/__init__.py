@@ -1,0 +1,1 @@
+from paddlebox_tpu.graph.graph_table import GraphTable  # noqa: F401

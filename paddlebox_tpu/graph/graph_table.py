@@ -1,0 +1,101 @@
+"""Graph engine for GNN training (graph-learning mode of the PS).
+
+≙ heter_ps/graph_gpu_ps_table.h GpuPsGraphTable + graph_gpu_wrapper +
+graph_sampler (SURVEY §2.2: device graph table with neighbor sampling and
+random walks feeding the sparse-PS embedding path).
+
+TPU-first shape: the adjacency is CSR in device arrays (indptr/indices —
+built host-side with the same key→dense-id discipline as the embedding pass
+working set), and sampling/walks are jit-able static-shape programs:
+per-draw uniform offsets into each node's neighbor span, `lax.scan` for
+walks (≙ graph_sampler walk kernels), alias tables for weighted graphs
+(ops/alias_method.py).  Degree-0 nodes yield -1 (masked downstream).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class GraphTable:
+    """Host-built CSR graph, device-sampled."""
+
+    def __init__(self, edges: np.ndarray,
+                 weights: Optional[np.ndarray] = None,
+                 num_nodes: Optional[int] = None):
+        """edges: [M, 2] (src, dst) dense node ids."""
+        edges = np.asarray(edges, np.int64)
+        n = int(num_nodes if num_nodes is not None else edges.max() + 1)
+        order = np.argsort(edges[:, 0], kind="stable")
+        src = edges[order, 0]
+        dst = edges[order, 1]
+        counts = np.bincount(src, minlength=n)
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        self.num_nodes = n
+        self.num_edges = len(edges)
+        self.indptr = jnp.asarray(indptr, jnp.int32)
+        self.indices = jnp.asarray(dst, jnp.int32)
+        if weights is not None:
+            w = np.asarray(weights, np.float64)[order]
+            # per-node alias tables over the neighbor span (weighted draws)
+            from paddlebox_tpu.ops.alias_method import build_alias_table
+            accept = np.zeros(self.num_edges, np.float32)
+            alias = np.zeros(self.num_edges, np.int32)
+            for node in range(n):
+                s, e = indptr[node], indptr[node + 1]
+                if e > s:
+                    a, al = build_alias_table(w[s:e])
+                    accept[s:e] = a
+                    alias[s:e] = al + s  # absolute edge positions
+            self.accept = jnp.asarray(accept)
+            self.alias = jnp.asarray(alias)
+        else:
+            self.accept = None
+            self.alias = None
+
+    # ------------------------------------------------------------------
+    def degrees(self, nodes: jnp.ndarray) -> jnp.ndarray:
+        return self.indptr[nodes + 1] - self.indptr[nodes]
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def sample_neighbors(self, nodes: jnp.ndarray, k: int,
+                         key: jax.Array) -> jnp.ndarray:
+        """Uniform (or alias-weighted) sample of k neighbors per node
+        (≙ graph_neighbor_sample, graph_gpu_ps_table_inl.cu).
+        nodes [B] → [B, k]; -1 where degree == 0."""
+        start = self.indptr[nodes]                     # [B]
+        deg = self.indptr[nodes + 1] - start
+        B = nodes.shape[0]
+        k1, k2 = jax.random.split(key)
+        off = jax.random.randint(k1, (B, k), 0, jnp.maximum(deg, 1)[:, None])
+        pos = start[:, None] + off
+        if self.accept is not None:
+            u = jax.random.uniform(k2, (B, k))
+            pos = jnp.where(u < self.accept[pos], pos, self.alias[pos])
+        nb = self.indices[pos]
+        return jnp.where(deg[:, None] > 0, nb, -1)
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def random_walk(self, starts: jnp.ndarray, length: int,
+                    key: jax.Array) -> jnp.ndarray:
+        """Deepwalk-style walks (≙ graph_sampler walk path).
+        starts [B] → [B, length+1]; stuck walks repeat their node."""
+        def step(carry, k):
+            cur = carry
+            nxt = self.sample_neighbors(jnp.maximum(cur, 0), 1, k)[:, 0]
+            nxt = jnp.where((cur >= 0) & (nxt >= 0), nxt, cur)
+            return nxt, nxt
+
+        keys = jax.random.split(key, length)
+        _, path = jax.lax.scan(step, starts, keys)
+        return jnp.concatenate([starts[:, None], path.T], axis=1)
+
+    def sample_nodes(self, key: jax.Array, count: int) -> jnp.ndarray:
+        """Uniform node draws (negative sampling, ≙ graph_node_sample)."""
+        return jax.random.randint(key, (count,), 0, self.num_nodes)

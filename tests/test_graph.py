@@ -1,0 +1,54 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.graph.graph_table import GraphTable
+
+
+def ring_graph(n=10):
+    edges = [(i, (i + 1) % n) for i in range(n)]
+    return GraphTable(np.array(edges), num_nodes=n)
+
+
+def test_csr_build():
+    g = GraphTable(np.array([(0, 1), (0, 2), (1, 2), (3, 0)]), num_nodes=5)
+    assert g.num_nodes == 5 and g.num_edges == 4
+    deg = np.asarray(g.degrees(jnp.arange(5)))
+    assert deg.tolist() == [2, 1, 0, 1, 0]
+
+
+def test_sample_neighbors_valid():
+    g = GraphTable(np.array([(0, 1), (0, 2), (1, 3), (2, 3)]), num_nodes=4)
+    nb = np.asarray(g.sample_neighbors(jnp.array([0, 1, 3]), 8,
+                                       jax.random.PRNGKey(0)))
+    assert set(nb[0]) <= {1, 2}
+    assert (nb[1] == 3).all()
+    assert (nb[2] == -1).all()  # degree 0
+
+
+def test_weighted_sampling_distribution():
+    # node 0 → 1 with weight 9, → 2 with weight 1
+    g = GraphTable(np.array([(0, 1), (0, 2)]),
+                   weights=np.array([9.0, 1.0]), num_nodes=3)
+    nb = np.asarray(g.sample_neighbors(jnp.zeros(5000, jnp.int32), 1,
+                                       jax.random.PRNGKey(1)))[:, 0]
+    frac_1 = (nb == 1).mean()
+    assert 0.85 < frac_1 < 0.95
+
+
+def test_random_walk_on_ring():
+    g = ring_graph(10)
+    walks = np.asarray(g.random_walk(jnp.arange(10), 5,
+                                     jax.random.PRNGKey(2)))
+    assert walks.shape == (10, 6)
+    # ring: each step advances by exactly 1 (deterministic, single neighbor)
+    for r in range(10):
+        np.testing.assert_array_equal(walks[r], (r + np.arange(6)) % 10)
+
+
+def test_walk_stuck_at_sink():
+    g = GraphTable(np.array([(0, 1)]), num_nodes=2)  # 1 has no out-edges
+    walks = np.asarray(g.random_walk(jnp.array([0]), 4,
+                                     jax.random.PRNGKey(3)))
+    np.testing.assert_array_equal(walks[0], [0, 1, 1, 1, 1])
