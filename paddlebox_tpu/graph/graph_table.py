@@ -42,22 +42,17 @@ class GraphTable:
         self.indptr = jnp.asarray(indptr, jnp.int32)
         self.indices = jnp.asarray(dst, jnp.int32)
         if weights is not None:
+            # Weighted draws by inverse-CDF over a global per-edge cumsum:
+            # the cumsum is nondecreasing, so a span draw is one batched
+            # searchsorted — O(m) vectorized build (vs per-node alias
+            # construction) and zero-weight spans degrade to the uniform
+            # fallback instead of a degenerate table.
             w = np.asarray(weights, np.float64)[order]
-            # per-node alias tables over the neighbor span (weighted draws)
-            from paddlebox_tpu.ops.alias_method import build_alias_table
-            accept = np.zeros(self.num_edges, np.float32)
-            alias = np.zeros(self.num_edges, np.int32)
-            for node in range(n):
-                s, e = indptr[node], indptr[node + 1]
-                if e > s:
-                    a, al = build_alias_table(w[s:e])
-                    accept[s:e] = a
-                    alias[s:e] = al + s  # absolute edge positions
-            self.accept = jnp.asarray(accept)
-            self.alias = jnp.asarray(alias)
+            if np.any(w < 0):
+                raise ValueError("negative edge weight")
+            self.cum_w = jnp.asarray(np.cumsum(w), jnp.float32)
         else:
-            self.accept = None
-            self.alias = None
+            self.cum_w = None
 
     # ------------------------------------------------------------------
     def degrees(self, nodes: jnp.ndarray) -> jnp.ndarray:
@@ -75,9 +70,17 @@ class GraphTable:
         k1, k2 = jax.random.split(key)
         off = jax.random.randint(k1, (B, k), 0, jnp.maximum(deg, 1)[:, None])
         pos = start[:, None] + off
-        if self.accept is not None:
+        if self.cum_w is not None:
+            end = start + deg
+            base = jnp.where(start > 0, self.cum_w[start - 1], 0.0)  # [B]
+            total = self.cum_w[jnp.maximum(end - 1, 0)] - base
             u = jax.random.uniform(k2, (B, k))
-            pos = jnp.where(u < self.accept[pos], pos, self.alias[pos])
+            v = base[:, None] + u * total[:, None]
+            wpos = jnp.searchsorted(self.cum_w, v, side="left")
+            # zero-total spans (all weights 0) keep the uniform draw
+            pos = jnp.where((total > 0)[:, None],
+                            jnp.clip(wpos, start[:, None],
+                                     jnp.maximum(end - 1, 0)[:, None]), pos)
         nb = self.indices[pos]
         return jnp.where(deg[:, None] > 0, nb, -1)
 

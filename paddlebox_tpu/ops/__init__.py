@@ -1,2 +1,6 @@
 from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm  # noqa: F401
+from paddlebox_tpu.ops.seqpool_cvm_variants import (  # noqa: F401
+    fused_seqpool_cvm_tradew, fused_seqpool_cvm_with_conv,
+    fused_seqpool_cvm_with_credit, fused_seqpool_cvm_with_diff_thres,
+    fused_seqpool_cvm_with_pcoc)
 from paddlebox_tpu.ops.cvm import cvm  # noqa: F401

@@ -1,0 +1,471 @@
+"""fused_seqpool_cvm op-family variants: tradew / with_conv / with_credit /
+with_diff_thres / with_pcoc.
+
+≙ operators/fused/fused_seqpool_cvm_{tradew,with_conv,with_credit,
+with_diff_thres,with_pcoc}_op.{cc,cu} in the reference.  Same shape contract
+as ops/seqpool_cvm.py: ``emb [S, B, L, H]`` batch-pack layout with
+per-(slot, instance) ``lengths`` — masked sums the XLA fuser turns into a
+single pass over the gathered embeddings.
+
+Backward passes mirror the reference CUDA grad kernels exactly (they are NOT
+the analytic VJPs): the leading "CVM" gradient columns are overwritten with
+per-instance statistics (show/click/... counts, or q_values for pcoc) so the
+push path accumulates lifecycle counters, and the embedx columns broadcast
+the pooled output grad over the valid keys.
+
+Variant summaries (all column indices refer to the per-key value vector):
+
+- tradew (fused_seqpool_cvm_tradew_op.cu:34-89,269-425): per-key layout
+  ``[cvm(2) | trade_w(T) | embedx]``; with ``trade_id >= 0`` the embedx pool
+  is weighted by the key's selected trade weight, and the backward produces
+  a real product-rule gradient for the weight column (the one variant whose
+  grad is analytic).
+- with_conv (fused_seqpool_cvm_with_conv_op.cu): cvm_offset=3
+  ``[show, click, conv]``; CVM stage show→log1p, click→log1p,
+  conv→log1p(conv)-log1p(click); ``show_filter`` drops the show column;
+  ``embedx_concate_size`` emits per-key (not pooled) slices.
+- with_credit (fused_seqpool_cvm_with_credit_op.cu): cvm_offset=4
+  ``[show, click, conv, credit]`` each log1p'd; ``show_filter`` drops show.
+- with_diff_thres (fused_seqpool_cvm_with_diff_thres_op.cu:95-145): base op
+  plus a per-slot threshold vector (``xbox_diff_thres_filter``) and
+  ``clk_filter`` (output keeps show only).
+- with_pcoc (fused_seqpool_cvm_with_pcoc_op.cu:120-310): leading columns
+  ``[show, clk, show2, clk2, pclk*pclk_num]`` producing smoothed ctr + pcoc
+  ratio features; grad uses an extra per-instance ``q_values`` input.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _keymask(lengths, L):
+    return jnp.arange(L)[None, None, :] < lengths[:, :, None]  # [S,B,L]
+
+
+def _filter_mask(emb, keymask, show_coeff, clk_coeff, threshold):
+    """Per-key show/click threshold filter (cols 0/1 of the value vector)."""
+    show, click = emb[..., 0], emb[..., 1]
+    keep = (show - click) * show_coeff + click * clk_coeff >= threshold
+    return keymask & keep
+
+
+def _masked_sum(vals, mask, pad_value):
+    w = mask.astype(vals.dtype)[..., None]
+    return pad_value + jnp.sum(vals * w, axis=2)  # [S, B, H]
+
+
+def _slot_major(out):
+    """[S, B, W] → [B, S*W] (per-slot output tensors, concatenated)."""
+    S, B, W = out.shape
+    return jnp.transpose(out, (1, 0, 2)).reshape(B, S * W)
+
+
+def _unslot_major(dy, S):
+    B = dy.shape[0]
+    W = dy.shape[1] // S
+    return dy.reshape(B, S, W).transpose(1, 0, 2)  # [S, B, W]
+
+
+def _log1p(x):
+    return jnp.log(x + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# tradew
+# ---------------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def fused_seqpool_cvm_tradew(emb, lengths, ins_cvm, use_cvm=True,
+                             pad_value=0.0, cvm_offset=2, trade_id=-1,
+                             trade_num=0):
+    """emb [S,B,L,E+trade_num] with per-key ``[cvm|trade_w|embedx]`` layout
+    → [B, S*E] (use_cvm) or [B, S*(E-cvm_offset)]."""
+    out, _ = _tradew_fwd_impl(emb, lengths, use_cvm, pad_value, cvm_offset,
+                              trade_id, trade_num)
+    return out
+
+
+def _tradew_fwd_impl(emb, lengths, use_cvm, pad_value, cvm_offset, trade_id,
+                     trade_num):
+    S, B, L, H = emb.shape
+    mask = _keymask(lengths, L)
+    cvm_part = emb[..., :cvm_offset]
+    embedx = emb[..., cvm_offset + trade_num:]
+    if trade_id >= 0:
+        tw = emb[..., cvm_offset + trade_id:cvm_offset + trade_id + 1]
+        embedx = embedx * tw
+    vals = jnp.concatenate([cvm_part, embedx], axis=-1)  # [S,B,L,E]
+    pooled = _masked_sum(vals, mask, pad_value)  # [S, B, E]
+    show = _log1p(pooled[..., 0:1])
+    click = _log1p(pooled[..., 1:2]) - show
+    if use_cvm:
+        out = jnp.concatenate([show, click, pooled[..., cvm_offset:]], -1)
+    else:
+        out = pooled[..., cvm_offset:]
+    return _slot_major(out), mask
+
+
+def _tradew_fwd(emb, lengths, ins_cvm, use_cvm, pad_value, cvm_offset,
+                trade_id, trade_num):
+    out, mask = _tradew_fwd_impl(emb, lengths, use_cvm, pad_value, cvm_offset,
+                                 trade_id, trade_num)
+    return out, (emb, mask, ins_cvm)
+
+
+def _tradew_bwd(use_cvm, pad_value, cvm_offset, trade_id, trade_num, res, dy):
+    emb, mask, ins_cvm = res
+    S, B, L, H = emb.shape
+    dy = _unslot_major(dy, S).astype(emb.dtype)  # [S, B, W]
+    d_embedx_out = dy[..., cvm_offset:] if use_cvm else dy  # [S,B,Ex]
+    w = mask.astype(emb.dtype)[..., None]  # [S,B,L,1]
+    if trade_id >= 0:
+        # FusedSeqpoolCVMTradeWGradKernel: cvm cols zeroed, selected trade
+        # col gets per-key dot(dy_embedx, key embedx), embedx cols get
+        # dy * key trade weight.
+        d_cvm = jnp.zeros((S, B, L, cvm_offset), emb.dtype)
+        embedx_in = emb[..., cvm_offset + trade_num:]
+        dot = jnp.einsum("sble,sbe->sbl", embedx_in, d_embedx_out)
+        d_trade = jnp.zeros((S, B, L, trade_num), emb.dtype)
+        d_trade = d_trade.at[..., trade_id].set(dot)
+        tw = emb[..., cvm_offset + trade_id:cvm_offset + trade_id + 1]
+        d_ex = d_embedx_out[:, :, None, :] * tw
+        d_emb = jnp.concatenate([d_cvm, d_trade, d_ex], -1) * w
+    else:
+        # NoTradeId: cvm cols ← instance cvm, trade cols ← 0, embedx ← dy.
+        d_cvm = jnp.broadcast_to(ins_cvm[None, :, None, :].astype(emb.dtype),
+                                 (S, B, L, cvm_offset))
+        d_trade = jnp.zeros((S, B, L, trade_num), emb.dtype)
+        d_ex = jnp.broadcast_to(d_embedx_out[:, :, None, :],
+                                (S, B, L, d_embedx_out.shape[-1]))
+        d_emb = jnp.concatenate([d_cvm, d_trade, d_ex], -1) * w
+    d_lengths = np.zeros((S, B), dtype=jax.dtypes.float0)
+    return d_emb, d_lengths, jnp.zeros_like(ins_cvm)
+
+
+fused_seqpool_cvm_tradew.defvjp(_tradew_fwd, _tradew_bwd)
+
+
+# ---------------------------------------------------------------------------
+# with_conv
+# ---------------------------------------------------------------------------
+
+CONV_OFFSET = 3  # show, click, conv
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def fused_seqpool_cvm_with_conv(emb, lengths, ins_cvm, use_cvm=True,
+                                pad_value=0.0, need_filter=False,
+                                show_coeff=0.2, clk_coeff=1.0,
+                                threshold=0.96, show_filter=False,
+                                embedx_concate_size=1):
+    """emb [S,B,L,E] with ``[show, click, conv, embedx]`` per-key layout →
+    [B, S*C*W] where C=embedx_concate_size and W is E (use_cvm), E-1
+    (show_filter) or E-3 (no cvm)."""
+    out, _ = _conv_fwd_impl(emb, lengths, use_cvm, pad_value, need_filter,
+                            show_coeff, clk_coeff, threshold, show_filter,
+                            embedx_concate_size)
+    return out
+
+
+def _conv_pool(emb, lengths, pad_value, need_filter, show_coeff, clk_coeff,
+               threshold, C):
+    """→ pooled [S, B, C, E], keymask [S, B, L]."""
+    S, B, L, E = emb.shape
+    mask = _keymask(lengths, L)
+    if need_filter:
+        mask = _filter_mask(emb, mask, show_coeff, clk_coeff, threshold)
+    if C == 1:
+        pooled = _masked_sum(emb, mask, pad_value)[:, :, None, :]
+    else:
+        # position k pools exactly key k (when k < length), else pad_value
+        # (FusedSeqpoolWithConvKernelNormalEmbedxConcate :96-124)
+        take = jnp.minimum(jnp.arange(C), L - 1)
+        vals = emb[:, :, take, :]  # [S,B,C,E]
+        mk = mask[:, :, take] & (jnp.arange(C)[None, None, :] < L)
+        pooled = pad_value + vals * mk.astype(emb.dtype)[..., None]
+    return pooled, mask
+
+
+def _conv_transform(pooled, use_cvm, show_filter):
+    """CVM stage on pooled [S,B,C,E] → [S,B,C,W]."""
+    show = _log1p(pooled[..., 0:1])
+    click = _log1p(pooled[..., 1:2])
+    conv = _log1p(pooled[..., 2:3]) - click
+    if use_cvm:
+        if show_filter:
+            return jnp.concatenate([click, conv, pooled[..., 3:]], -1)
+        return jnp.concatenate([show, click, conv, pooled[..., 3:]], -1)
+    return pooled[..., CONV_OFFSET:]
+
+
+def _conv_fwd_impl(emb, lengths, use_cvm, pad_value, need_filter, show_coeff,
+                   clk_coeff, threshold, show_filter, C):
+    S, B, L, E = emb.shape
+    pooled, mask = _conv_pool(emb, lengths, pad_value, need_filter,
+                              show_coeff, clk_coeff, threshold, C)
+    out = _conv_transform(pooled, use_cvm, show_filter)  # [S,B,C,W]
+    out = out.reshape(S, B, -1)
+    return _slot_major(out), mask
+
+
+def _conv_fwd(emb, lengths, ins_cvm, use_cvm, pad_value, need_filter,
+              show_coeff, clk_coeff, threshold, show_filter, C):
+    out, mask = _conv_fwd_impl(emb, lengths, use_cvm, pad_value, need_filter,
+                               show_coeff, clk_coeff, threshold,
+                               show_filter, C)
+    return out, (mask, ins_cvm)
+
+
+def _conv_bwd(use_cvm, pad_value, need_filter, show_coeff, clk_coeff,
+              threshold, show_filter, C, res, dy):
+    mask, ins_cvm = res
+    S, B, L = mask.shape
+    dt = dy.dtype
+    dy = _unslot_major(dy, S).reshape(S, B, C, -1)  # [S,B,C,W]
+    if use_cvm and show_filter:
+        # WithShow grad (:537-563): all three cvm cols ← instance cvm,
+        # embedx ← dy shifted by the dropped show column.
+        d_pooled = jnp.concatenate(
+            [jnp.broadcast_to(ins_cvm[None, :, None, :].astype(dt),
+                              (S, B, C, CONV_OFFSET)),
+             dy[..., CONV_OFFSET - 1:]], -1)
+    elif use_cvm:
+        d_pooled = jnp.concatenate(
+            [jnp.broadcast_to(ins_cvm[None, :, None, :].astype(dt),
+                              (S, B, C, CONV_OFFSET)),
+             dy[..., CONV_OFFSET:]], -1)
+    else:
+        d_pooled = jnp.concatenate(
+            [jnp.broadcast_to(ins_cvm[None, :, None, :].astype(dt),
+                              (S, B, C, CONV_OFFSET)), dy], -1)
+    w = mask.astype(dt)
+    if C == 1:
+        d_emb = d_pooled[:, :, 0, :][:, :, None, :] * w[..., None]
+    else:
+        # key k takes grad from concat position min(k, C-1)
+        # (GradKernelWithCVMConcate :517-533: last position covers the tail)
+        pos = jnp.minimum(jnp.arange(L), C - 1)
+        d_emb = d_pooled[:, :, pos, :] * w[..., None]
+    d_lengths = np.zeros((S, B), dtype=jax.dtypes.float0)
+    return d_emb, d_lengths, jnp.zeros_like(ins_cvm)
+
+
+fused_seqpool_cvm_with_conv.defvjp(_conv_fwd, _conv_bwd)
+
+
+# ---------------------------------------------------------------------------
+# with_credit
+# ---------------------------------------------------------------------------
+
+CREDIT_OFFSET = 4  # show, click, conv, credit
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_seqpool_cvm_with_credit(emb, lengths, ins_cvm, use_cvm=True,
+                                  pad_value=0.0, show_filter=False):
+    """emb [S,B,L,E] with ``[show, click, conv, credit, embedx]`` layout →
+    [B, S*W]; the four lifecycle columns are each log1p'd
+    (FusedCVMWithCreditKernelWithCVM :53-71)."""
+    out, _ = _credit_fwd_impl(emb, lengths, use_cvm, pad_value, show_filter)
+    return out
+
+
+def _credit_fwd_impl(emb, lengths, use_cvm, pad_value, show_filter):
+    S, B, L, E = emb.shape
+    mask = _keymask(lengths, L)
+    pooled = _masked_sum(emb, mask, pad_value)  # [S,B,E]
+    if use_cvm:
+        cvm_cols = _log1p(pooled[..., :CREDIT_OFFSET])
+        if show_filter:
+            out = jnp.concatenate([cvm_cols[..., 1:],
+                                   pooled[..., CREDIT_OFFSET:]], -1)
+        else:
+            out = jnp.concatenate([cvm_cols, pooled[..., CREDIT_OFFSET:]], -1)
+    else:
+        out = pooled[..., CREDIT_OFFSET:]
+    return _slot_major(out), mask
+
+
+def _credit_fwd(emb, lengths, ins_cvm, use_cvm, pad_value, show_filter):
+    out, mask = _credit_fwd_impl(emb, lengths, use_cvm, pad_value,
+                                 show_filter)
+    return out, (mask, ins_cvm)
+
+
+def _credit_bwd(use_cvm, pad_value, show_filter, res, dy):
+    mask, ins_cvm = res
+    S, B, L = mask.shape
+    dt = dy.dtype
+    dy = _unslot_major(dy, S)
+    if use_cvm:
+        skip = CREDIT_OFFSET - 1 if show_filter else CREDIT_OFFSET
+        d_embedx = dy[..., skip:]
+    else:
+        d_embedx = dy
+    d_cvm = jnp.broadcast_to(ins_cvm[None, :, :].astype(dt),
+                             (S, B, CREDIT_OFFSET))
+    d_pooled = jnp.concatenate([d_cvm, d_embedx], -1)
+    d_emb = d_pooled[:, :, None, :] * mask.astype(dt)[..., None]
+    d_lengths = np.zeros((S, B), dtype=jax.dtypes.float0)
+    return d_emb, d_lengths, jnp.zeros_like(ins_cvm)
+
+
+fused_seqpool_cvm_with_credit.defvjp(_credit_fwd, _credit_bwd)
+
+
+# ---------------------------------------------------------------------------
+# with_diff_thres
+# ---------------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
+def fused_seqpool_cvm_with_diff_thres(emb, lengths, ins_cvm, use_cvm=True,
+                                      pad_value=0.0, need_filter=False,
+                                      show_coeff=0.2, clk_coeff=1.0,
+                                      threshold=0.96, threshold_vec=(),
+                                      quant_ratio=0, clk_filter=False,
+                                      xbox_diff_thres_filter=False):
+    """Base fused_seqpool_cvm plus per-slot thresholds
+    (``threshold_vec[slot]`` when xbox_diff_thres_filter) and ``clk_filter``
+    (output [log1p(show), embedx], the click column dropped)."""
+    out, _ = _dt_fwd_impl(emb, lengths, use_cvm, pad_value, need_filter,
+                          show_coeff, clk_coeff, threshold, threshold_vec,
+                          quant_ratio, clk_filter, xbox_diff_thres_filter)
+    return out
+
+
+def _dt_fwd_impl(emb, lengths, use_cvm, pad_value, need_filter, show_coeff,
+                 clk_coeff, threshold, threshold_vec, quant_ratio, clk_filter,
+                 xbox_diff_thres_filter):
+    S, B, L, E = emb.shape
+    mask = _keymask(lengths, L)
+    if need_filter:
+        thr = (jnp.asarray(threshold_vec, emb.dtype)[:, None, None]
+               if xbox_diff_thres_filter else threshold)
+        mask = _filter_mask(emb, mask, show_coeff, clk_coeff, thr)
+    if quant_ratio > 0:
+        ex = jnp.floor(emb[..., 2:] * quant_ratio + 0.5) / quant_ratio
+        vals = jnp.concatenate([emb[..., :2], ex], -1)
+    else:
+        vals = emb
+    pooled = _masked_sum(vals, mask, pad_value)
+    show = _log1p(pooled[..., 0:1])
+    click = _log1p(pooled[..., 1:2]) - show
+    if use_cvm:
+        if clk_filter:
+            out = jnp.concatenate([show, pooled[..., 2:]], -1)
+        else:
+            out = jnp.concatenate([show, click, pooled[..., 2:]], -1)
+    else:
+        out = pooled[..., 2:]
+    return _slot_major(out), mask
+
+
+def _dt_fwd(emb, lengths, ins_cvm, *nd):
+    out, mask = _dt_fwd_impl(emb, lengths, *nd)
+    return out, (mask, ins_cvm)
+
+
+def _dt_bwd(use_cvm, pad_value, need_filter, show_coeff, clk_coeff, threshold,
+            threshold_vec, quant_ratio, clk_filter, xbox_diff_thres_filter,
+            res, dy):
+    mask, ins_cvm = res
+    S, B, L = mask.shape
+    dt = dy.dtype
+    dy = _unslot_major(dy, S)
+    if use_cvm:
+        d_embedx = dy[..., 1:] if clk_filter else dy[..., 2:]
+    else:
+        d_embedx = dy
+    d_cvm = jnp.broadcast_to(ins_cvm[None, :, :].astype(dt), (S, B, 2))
+    d_pooled = jnp.concatenate([d_cvm, d_embedx], -1)
+    d_emb = d_pooled[:, :, None, :] * mask.astype(dt)[..., None]
+    d_lengths = np.zeros((S, B), dtype=jax.dtypes.float0)
+    return d_emb, d_lengths, jnp.zeros_like(ins_cvm)
+
+
+fused_seqpool_cvm_with_diff_thres.defvjp(_dt_fwd, _dt_bwd)
+
+
+# ---------------------------------------------------------------------------
+# with_pcoc
+# ---------------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12))
+def fused_seqpool_cvm_with_pcoc(emb, lengths, ins_cvm, q_values, use_cvm=True,
+                                pad_value=0.0, need_filter=False,
+                                show_coeff=0.2, clk_coeff=1.0,
+                                threshold=0.96, cvm_offset=7,
+                                max_cvm_offset=7, quant_ratio=0):
+    """emb [S,B,L,E] with leading ``[show, clk, show2, clk2,
+    pclk*(cvm_offset-4)]`` columns; ins_cvm [B, cvm_offset]; q_values
+    [B, cvm_offset-4].  Output columns (use_cvm): log1p(show),
+    smoothed ctr, pclk_num pcoc-vs-show2 ratios, pclk_num pcoc-vs-clk2
+    ratios, then embedx (FusedCVMWithPCOCKernelWithCVM :122-157)."""
+    out, _ = _pcoc_fwd_impl(emb, lengths, use_cvm, pad_value, need_filter,
+                            show_coeff, clk_coeff, threshold, cvm_offset,
+                            max_cvm_offset, quant_ratio)
+    return out
+
+
+def _pcoc_fwd_impl(emb, lengths, use_cvm, pad_value, need_filter, show_coeff,
+                   clk_coeff, threshold, cvm_offset, max_cvm_offset,
+                   quant_ratio):
+    S, B, L, E = emb.shape
+    pclk_num = cvm_offset - 4
+    mask = _keymask(lengths, L)
+    if need_filter:
+        mask = _filter_mask(emb, mask, show_coeff, clk_coeff, threshold)
+    if quant_ratio > 0:
+        ex = (jnp.floor(emb[..., max_cvm_offset:] * quant_ratio + 0.5)
+              / quant_ratio)
+        vals = jnp.concatenate([emb[..., :max_cvm_offset], ex], -1)
+    else:
+        vals = emb
+    pooled = _masked_sum(vals, mask, pad_value)  # [S,B,E]
+    if use_cvm:
+        # log1p only the lifecycle columns — embedx sums can be < -1 and
+        # would produce NaN lanes (sliced away, but they trip jax_debug_nans)
+        lg = _log1p(pooled[..., :4 + pclk_num])
+        show = lg[..., 0:1]
+        ctr = lg[..., 1:2] - lg[..., 0:1]
+        pcoc1 = lg[..., 4:4 + pclk_num] - lg[..., 2:3]
+        pcoc2 = lg[..., 4:4 + pclk_num] - lg[..., 3:4]
+        out = jnp.concatenate(
+            [show, ctr, pcoc1, pcoc2, pooled[..., max_cvm_offset:]], -1)
+    else:
+        out = pooled[..., max_cvm_offset:]
+    return _slot_major(out), mask
+
+
+def _pcoc_fwd(emb, lengths, ins_cvm, q_values, *nd):
+    out, mask = _pcoc_fwd_impl(emb, lengths, *nd)
+    return out, (mask, ins_cvm, q_values)
+
+
+def _pcoc_bwd(use_cvm, pad_value, need_filter, show_coeff, clk_coeff,
+              threshold, cvm_offset, max_cvm_offset, quant_ratio, res, dy):
+    mask, ins_cvm, q_values = res
+    S, B, L = mask.shape
+    dt = dy.dtype
+    pclk_num = cvm_offset - 4
+    embed_index_diff = max_cvm_offset - 2 - 2 * pclk_num
+    dy = _unslot_major(dy, S)
+    d_embedx = dy[..., max_cvm_offset - embed_index_diff:] if use_cvm else dy
+    # cols 0..3 ← instance show/clk/show2/clk2; cols 4..cvm_offset ← q_values;
+    # cols cvm_offset..max_cvm_offset ← 0 (GradKernelWithCVM :274-284)
+    d_lead = jnp.concatenate(
+        [jnp.broadcast_to(ins_cvm[None, :, :4].astype(dt), (S, B, 4)),
+         jnp.broadcast_to(q_values[None, :, :].astype(dt), (S, B, pclk_num)),
+         jnp.zeros((S, B, max_cvm_offset - cvm_offset), dt)], -1)
+    d_pooled = jnp.concatenate([d_lead, d_embedx], -1)
+    d_emb = d_pooled[:, :, None, :] * mask.astype(dt)[..., None]
+    d_lengths = np.zeros((S, B), dtype=jax.dtypes.float0)
+    return d_emb, d_lengths, jnp.zeros_like(ins_cvm), jnp.zeros_like(q_values)
+
+
+fused_seqpool_cvm_with_pcoc.defvjp(_pcoc_fwd, _pcoc_bwd)

@@ -1,0 +1,321 @@
+"""fused_seqpool_cvm variant ops vs direct numpy transcriptions of the
+reference CUDA kernel semantics (fused_seqpool_cvm_*_op.cu)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.ops.seqpool_cvm_variants import (
+    fused_seqpool_cvm_tradew, fused_seqpool_cvm_with_conv,
+    fused_seqpool_cvm_with_credit, fused_seqpool_cvm_with_diff_thres,
+    fused_seqpool_cvm_with_pcoc)
+
+S, B, L = 3, 5, 4
+RNG = np.random.default_rng(7)
+
+
+def make(E, low=0.0, high=2.0):
+    emb = RNG.uniform(low, high, (S, B, L, E)).astype(np.float32)
+    lengths = RNG.integers(0, L + 1, (S, B)).astype(np.int32)
+    lengths[0, 0] = 0  # empty sequence edge case
+    return emb, lengths
+
+
+def log1p(x):
+    return np.log(x + 1.0)
+
+
+def pool_np(emb, lengths, pad=0.0, mask_extra=None):
+    S_, B_, L_, E = emb.shape
+    out = np.full((S_, B_, E), pad, np.float64)
+    for s in range(S_):
+        for b in range(B_):
+            for k in range(lengths[s, b]):
+                if mask_extra is not None and not mask_extra[s, b, k]:
+                    continue
+                out[s, b] += emb[s, b, k]
+    return out.astype(np.float32)
+
+
+def slot_major(out):
+    return np.transpose(out, (1, 0, 2)).reshape(B, -1)
+
+
+# --------------------------------------------------------------- tradew ----
+
+@pytest.mark.parametrize("use_cvm", [True, False])
+@pytest.mark.parametrize("trade_id", [-1, 1])
+def test_tradew_forward(use_cvm, trade_id):
+    T, E = 3, 7  # hidden = E + T
+    emb, lengths = make(E + T)
+    ins_cvm = RNG.uniform(0, 3, (B, 2)).astype(np.float32)
+
+    got = fused_seqpool_cvm_tradew(jnp.asarray(emb), jnp.asarray(lengths),
+                                   jnp.asarray(ins_cvm), use_cvm, 0.0, 2,
+                                   trade_id, T)
+    # numpy: pooled cvm from cols 0:2, embedx from cols 2+T: (weighted)
+    ex = emb[..., 2 + T:]
+    if trade_id >= 0:
+        ex = ex * emb[..., 2 + trade_id:2 + trade_id + 1]
+    vals = np.concatenate([emb[..., :2], ex], -1)
+    pooled = pool_np(vals, lengths)
+    show = log1p(pooled[..., 0:1])
+    click = log1p(pooled[..., 1:2]) - show
+    exp = (np.concatenate([show, click, pooled[..., 2:]], -1)
+           if use_cvm else pooled[..., 2:])
+    np.testing.assert_allclose(np.asarray(got), slot_major(exp), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_tradew_grad_trade_weight_product_rule():
+    T, E = 2, 5
+    emb, lengths = make(E + T)
+    ins_cvm = np.ones((B, 2), np.float32)
+    trade_id = 0
+
+    def f(e):
+        return jnp.sum(fused_seqpool_cvm_tradew(
+            e, jnp.asarray(lengths), jnp.asarray(ins_cvm), True, 0.0, 2,
+            trade_id, T) ** 2)
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(emb)))
+    # reference semantics: dy = 2*out on embedx cols; trade col trade_id of
+    # key k = dot(dy_embedx, embedx_key); embedx cols = dy * trade_w;
+    # cvm cols = 0
+    out = np.asarray(fused_seqpool_cvm_tradew(
+        jnp.asarray(emb), jnp.asarray(lengths), jnp.asarray(ins_cvm), True,
+        0.0, 2, trade_id, T))
+    dy = (2 * out).reshape(B, S, E).transpose(1, 0, 2)
+    for s in range(S):
+        for b in range(B):
+            for k in range(L):
+                valid = k < lengths[s, b]
+                np.testing.assert_allclose(g[s, b, k, :2], 0.0)
+                if not valid:
+                    np.testing.assert_allclose(g[s, b, k], 0.0)
+                    continue
+                dot = np.dot(dy[s, b, 2:], emb[s, b, k, 2 + T:])
+                np.testing.assert_allclose(g[s, b, k, 2 + trade_id], dot,
+                                           rtol=1e-4, atol=1e-4)
+                np.testing.assert_allclose(g[s, b, k, 2 + 1 - trade_id], 0.0)
+                np.testing.assert_allclose(
+                    g[s, b, k, 2 + T:],
+                    dy[s, b, 2:] * emb[s, b, k, 2 + trade_id], rtol=1e-4,
+                    atol=1e-4)
+
+
+# ------------------------------------------------------------- with_conv ---
+
+@pytest.mark.parametrize("use_cvm,show_filter", [(True, False), (True, True),
+                                                 (False, False)])
+def test_with_conv_forward(use_cvm, show_filter):
+    E = 6
+    emb, lengths = make(E)
+    ins_cvm = RNG.uniform(0, 2, (B, 3)).astype(np.float32)
+    got = fused_seqpool_cvm_with_conv(
+        jnp.asarray(emb), jnp.asarray(lengths), jnp.asarray(ins_cvm),
+        use_cvm, 0.0, False, 0.2, 1.0, 0.96, show_filter, 1)
+    pooled = pool_np(emb, lengths)
+    show = log1p(pooled[..., 0:1])
+    click = log1p(pooled[..., 1:2])
+    conv = log1p(pooled[..., 2:3]) - click
+    if not use_cvm:
+        exp = pooled[..., 3:]
+    elif show_filter:
+        exp = np.concatenate([click, conv, pooled[..., 3:]], -1)
+    else:
+        exp = np.concatenate([show, click, conv, pooled[..., 3:]], -1)
+    np.testing.assert_allclose(np.asarray(got), slot_major(exp), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_with_conv_filter_and_concate():
+    E = 5
+    emb, lengths = make(E)
+    ins_cvm = np.ones((B, 3), np.float32)
+    C = 2
+    got = fused_seqpool_cvm_with_conv(
+        jnp.asarray(emb), jnp.asarray(lengths), jnp.asarray(ins_cvm),
+        True, 0.0, True, 0.2, 1.0, 0.96, False, C)
+    # concate: position k = key k's value (if valid & passes filter) else 0
+    exp = np.zeros((S, B, C, E), np.float32)
+    for s in range(S):
+        for b in range(B):
+            for k in range(min(C, lengths[s, b])):
+                v = emb[s, b, k]
+                if (v[0] - v[1]) * 0.2 + v[1] * 1.0 >= 0.96:
+                    exp[s, b, k] = v
+    show = log1p(exp[..., 0:1])
+    click = log1p(exp[..., 1:2])
+    conv = log1p(exp[..., 2:3]) - click
+    expt = np.concatenate([show, click, conv, exp[..., 3:]], -1)
+    np.testing.assert_allclose(np.asarray(got),
+                               expt.reshape(S, B, -1).transpose(1, 0, 2)
+                               .reshape(B, -1).astype(np.float32),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_with_conv_grad_show_filter():
+    E = 5
+    emb, lengths = make(E)
+    ins_cvm = RNG.uniform(0, 2, (B, 3)).astype(np.float32)
+
+    def f(e):
+        return jnp.sum(fused_seqpool_cvm_with_conv(
+            e, jnp.asarray(lengths), jnp.asarray(ins_cvm), True, 0.0, False,
+            0.2, 1.0, 0.96, True, 1))
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(emb)))
+    # dy == 1 everywhere; cvm cols ← ins_cvm (all 3), embedx ← dy
+    for s in range(S):
+        for b in range(B):
+            for k in range(L):
+                if k < lengths[s, b]:
+                    np.testing.assert_allclose(g[s, b, k, :3], ins_cvm[b],
+                                               rtol=1e-6)
+                    np.testing.assert_allclose(g[s, b, k, 3:], 1.0)
+                else:
+                    np.testing.assert_allclose(g[s, b, k], 0.0)
+
+
+# ----------------------------------------------------------- with_credit ---
+
+@pytest.mark.parametrize("use_cvm,show_filter", [(True, False), (True, True),
+                                                 (False, False)])
+def test_with_credit_forward(use_cvm, show_filter):
+    E = 7
+    emb, lengths = make(E)
+    ins_cvm = RNG.uniform(0, 2, (B, 4)).astype(np.float32)
+    got = fused_seqpool_cvm_with_credit(
+        jnp.asarray(emb), jnp.asarray(lengths), jnp.asarray(ins_cvm),
+        use_cvm, 0.0, show_filter)
+    pooled = pool_np(emb, lengths)
+    lg = log1p(pooled[..., :4])
+    if not use_cvm:
+        exp = pooled[..., 4:]
+    elif show_filter:
+        exp = np.concatenate([lg[..., 1:], pooled[..., 4:]], -1)
+    else:
+        exp = np.concatenate([lg, pooled[..., 4:]], -1)
+    np.testing.assert_allclose(np.asarray(got), slot_major(exp), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_with_credit_grad():
+    E = 6
+    emb, lengths = make(E)
+    ins_cvm = RNG.uniform(0, 2, (B, 4)).astype(np.float32)
+
+    def f(e):
+        return jnp.sum(fused_seqpool_cvm_with_credit(
+            e, jnp.asarray(lengths), jnp.asarray(ins_cvm), True, 0.0, False))
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(emb)))
+    for s in range(S):
+        for b in range(B):
+            for k in range(L):
+                if k < lengths[s, b]:
+                    np.testing.assert_allclose(g[s, b, k, :4], ins_cvm[b],
+                                               rtol=1e-6)
+                    np.testing.assert_allclose(g[s, b, k, 4:], 1.0)
+                else:
+                    np.testing.assert_allclose(g[s, b, k], 0.0)
+
+
+# ------------------------------------------------------- with_diff_thres ---
+
+def test_diff_thres_per_slot_threshold():
+    E = 5
+    emb, lengths = make(E)
+    ins_cvm = np.ones((B, 2), np.float32)
+    tv = [0.5, 100.0, 0.0]  # slot 1 filters everything out
+    got = fused_seqpool_cvm_with_diff_thres(
+        jnp.asarray(emb), jnp.asarray(lengths), jnp.asarray(ins_cvm),
+        True, 0.0, True, 0.2, 1.0, 0.96, tuple(tv), 0, False, True)
+    keep = np.zeros((S, B, L), bool)
+    for s in range(S):
+        for b in range(B):
+            for k in range(lengths[s, b]):
+                v = emb[s, b, k]
+                keep[s, b, k] = ((v[0] - v[1]) * 0.2 + v[1] >= tv[s])
+    pooled = pool_np(emb, lengths, mask_extra=keep)
+    show = log1p(pooled[..., 0:1])
+    click = log1p(pooled[..., 1:2]) - show
+    exp = np.concatenate([show, click, pooled[..., 2:]], -1)
+    np.testing.assert_allclose(np.asarray(got), slot_major(exp), rtol=2e-5,
+                               atol=2e-5)
+    # slot 1 fully filtered → zeros in pooled → log1p(0)=0 outputs
+    got_s1 = np.asarray(got).reshape(B, S, E)[:, 1, :]
+    np.testing.assert_allclose(got_s1, 0.0, atol=1e-6)
+
+
+def test_diff_thres_clk_filter():
+    E = 5
+    emb, lengths = make(E)
+    ins_cvm = RNG.uniform(0, 2, (B, 2)).astype(np.float32)
+    got = fused_seqpool_cvm_with_diff_thres(
+        jnp.asarray(emb), jnp.asarray(lengths), jnp.asarray(ins_cvm),
+        True, 0.0, False, 0.2, 1.0, 0.96, (), 0, True, False)
+    pooled = pool_np(emb, lengths)
+    exp = np.concatenate([log1p(pooled[..., 0:1]), pooled[..., 2:]], -1)
+    np.testing.assert_allclose(np.asarray(got), slot_major(exp), rtol=2e-5,
+                               atol=2e-5)
+    # grad: both cvm cols ← ins_cvm, embedx ← dy
+    def f(e):
+        return jnp.sum(fused_seqpool_cvm_with_diff_thres(
+            e, jnp.asarray(lengths), jnp.asarray(ins_cvm), True, 0.0, False,
+            0.2, 1.0, 0.96, (), 0, True, False))
+    g = np.asarray(jax.grad(f)(jnp.asarray(emb)))
+    for s in range(S):
+        for b in range(B):
+            for k in range(lengths[s, b]):
+                np.testing.assert_allclose(g[s, b, k, :2], ins_cvm[b],
+                                           rtol=1e-6)
+                np.testing.assert_allclose(g[s, b, k, 2:], 1.0)
+
+
+# ------------------------------------------------------------- with_pcoc ---
+
+def test_pcoc_forward():
+    cvm_off = 7  # show, clk, show2, clk2, pclk x3
+    pclk_num = cvm_off - 4
+    E = cvm_off + 4
+    emb, lengths = make(E)
+    ins_cvm = RNG.uniform(0, 2, (B, cvm_off)).astype(np.float32)
+    q = RNG.uniform(0, 1, (B, pclk_num)).astype(np.float32)
+    got = fused_seqpool_cvm_with_pcoc(
+        jnp.asarray(emb), jnp.asarray(lengths), jnp.asarray(ins_cvm),
+        jnp.asarray(q), True, 0.0, False, 0.2, 1.0, 0.96, cvm_off, cvm_off, 0)
+    pooled = pool_np(emb, lengths)
+    lg = log1p(pooled)
+    show = lg[..., 0:1]
+    ctr = lg[..., 1:2] - lg[..., 0:1]
+    p1 = lg[..., 4:4 + pclk_num] - lg[..., 2:3]
+    p2 = lg[..., 4:4 + pclk_num] - lg[..., 3:4]
+    exp = np.concatenate([show, ctr, p1, p2, pooled[..., cvm_off:]], -1)
+    np.testing.assert_allclose(np.asarray(got), slot_major(exp), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_pcoc_grad_q_values():
+    cvm_off = 6  # pclk_num = 2
+    pclk_num = 2
+    E = cvm_off + 3
+    emb, lengths = make(E)
+    ins_cvm = RNG.uniform(0, 2, (B, cvm_off)).astype(np.float32)
+    q = RNG.uniform(0, 1, (B, pclk_num)).astype(np.float32)
+
+    def f(e):
+        return jnp.sum(fused_seqpool_cvm_with_pcoc(
+            e, jnp.asarray(lengths), jnp.asarray(ins_cvm), jnp.asarray(q),
+            True, 0.0, False, 0.2, 1.0, 0.96, cvm_off, cvm_off, 0))
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(emb)))
+    for s in range(S):
+        for b in range(B):
+            for k in range(lengths[s, b]):
+                np.testing.assert_allclose(g[s, b, k, :4], ins_cvm[b, :4],
+                                           rtol=1e-6)
+                np.testing.assert_allclose(g[s, b, k, 4:6], q[b], rtol=1e-6)
+                np.testing.assert_allclose(g[s, b, k, 6:], 1.0)
