@@ -8,8 +8,10 @@ TPU-first shape: the adjacency is CSR in device arrays (indptr/indices —
 built host-side with the same key→dense-id discipline as the embedding pass
 working set), and sampling/walks are jit-able static-shape programs:
 per-draw uniform offsets into each node's neighbor span, `lax.scan` for
-walks (≙ graph_sampler walk kernels), alias tables for weighted graphs
-(ops/alias_method.py).  Degree-0 nodes yield -1 (masked downstream).
+walks (≙ graph_sampler walk kernels), and weighted draws by inverse-CDF
+binary search over per-span normalized CDFs (f64-built on host so float32
+resolution is span-local, never global).  Degree-0 nodes yield -1 (masked
+downstream).
 """
 
 from __future__ import annotations
@@ -41,18 +43,29 @@ class GraphTable:
         self.num_edges = len(edges)
         self.indptr = jnp.asarray(indptr, jnp.int32)
         self.indices = jnp.asarray(dst, jnp.int32)
-        if weights is not None:
-            # Weighted draws by inverse-CDF over a global per-edge cumsum:
-            # the cumsum is nondecreasing, so a span draw is one batched
-            # searchsorted — O(m) vectorized build (vs per-node alias
-            # construction) and zero-weight spans degrade to the uniform
-            # fallback instead of a degenerate table.
+        if weights is not None and len(edges) > 0:
+            # Per-span normalized CDF, built in f64: float32 only ever
+            # stores values in [0, 1] *within* a span, so resolution never
+            # degrades with graph size (a single global f32 cumsum loses
+            # per-edge increments past ~2^24 total weight).  Zero-weight
+            # spans get a uniform CDF instead of a degenerate table.
             w = np.asarray(weights, np.float64)[order]
             if np.any(w < 0):
                 raise ValueError("negative edge weight")
-            self.cum_w = jnp.asarray(np.cumsum(w), jnp.float32)
+            m = len(w)
+            cums = np.cumsum(w)
+            span_id = np.repeat(np.arange(n), counts)
+            span_start = indptr[span_id]
+            span_end = indptr[span_id + 1]
+            base = np.where(span_start > 0, cums[span_start - 1], 0.0)
+            tot = cums[span_end - 1] - base
+            uniform = ((np.arange(m) - span_start + 1)
+                       / np.maximum(span_end - span_start, 1))
+            lc = np.where(tot > 0, (cums - base) / np.where(tot > 0, tot, 1.0),
+                          uniform)
+            self.local_cdf = jnp.asarray(lc, jnp.float32)
         else:
-            self.cum_w = None
+            self.local_cdf = None
 
     # ------------------------------------------------------------------
     def degrees(self, nodes: jnp.ndarray) -> jnp.ndarray:
@@ -70,17 +83,26 @@ class GraphTable:
         k1, k2 = jax.random.split(key)
         off = jax.random.randint(k1, (B, k), 0, jnp.maximum(deg, 1)[:, None])
         pos = start[:, None] + off
-        if self.cum_w is not None:
+        if self.local_cdf is not None:
             end = start + deg
-            base = jnp.where(start > 0, self.cum_w[start - 1], 0.0)  # [B]
-            total = self.cum_w[jnp.maximum(end - 1, 0)] - base
             u = jax.random.uniform(k2, (B, k))
-            v = base[:, None] + u * total[:, None]
-            wpos = jnp.searchsorted(self.cum_w, v, side="left")
-            # zero-total spans (all weights 0) keep the uniform draw
-            pos = jnp.where((total > 0)[:, None],
-                            jnp.clip(wpos, start[:, None],
-                                     jnp.maximum(end - 1, 0)[:, None]), pos)
+            lc = self.local_cdf
+            m = lc.shape[0]
+            # first edge e in the span with local_cdf[e] >= u — branchless
+            # binary search (32 steps covers any span)
+            lo = jnp.broadcast_to(start[:, None], (B, k))
+            hi = jnp.broadcast_to(end[:, None], (B, k))
+
+            def bs(_, lh):
+                lo, hi = lh
+                mid = (lo + hi) // 2
+                go = lc[jnp.clip(mid, 0, m - 1)] < u
+                return (jnp.where(go, mid + 1, lo), jnp.where(go, hi, mid))
+
+            lo, _ = jax.lax.fori_loop(0, 32, bs, (lo, hi))
+            wpos = jnp.clip(lo, start[:, None],
+                            jnp.maximum(end - 1, 0)[:, None])
+            pos = jnp.where(deg[:, None] > 0, wpos, pos)
         nb = self.indices[pos]
         return jnp.where(deg[:, None] > 0, nb, -1)
 

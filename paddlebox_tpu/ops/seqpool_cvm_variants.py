@@ -104,7 +104,9 @@ def _tradew_fwd_impl(emb, lengths, use_cvm, pad_value, cvm_offset, trade_id,
     show = _log1p(pooled[..., 0:1])
     click = _log1p(pooled[..., 1:2]) - show
     if use_cvm:
-        out = jnp.concatenate([show, click, pooled[..., cvm_offset:]], -1)
+        # cols 2..cvm_offset (if any) pass through raw, keeping fwd width E
+        # consistent with the dy[..., cvm_offset:] slice in the backward
+        out = jnp.concatenate([show, click, pooled[..., 2:]], -1)
     else:
         out = pooled[..., cvm_offset:]
     return _slot_major(out), mask
@@ -138,7 +140,10 @@ def _tradew_bwd(use_cvm, pad_value, cvm_offset, trade_id, trade_num, res, dy):
     else:
         # NoTradeId: cvm cols ← instance cvm, trade cols ← 0, embedx ← dy.
         d_cvm = jnp.broadcast_to(ins_cvm[None, :, None, :].astype(emb.dtype),
-                                 (S, B, L, cvm_offset))
+                                 (S, B, L, 2))
+        if cvm_offset > 2:
+            d_cvm = jnp.concatenate(
+                [d_cvm, jnp.zeros((S, B, L, cvm_offset - 2), emb.dtype)], -1)
         d_trade = jnp.zeros((S, B, L, trade_num), emb.dtype)
         d_ex = jnp.broadcast_to(d_embedx_out[:, :, None, :],
                                 (S, B, L, d_embedx_out.shape[-1]))

@@ -1,0 +1,88 @@
+"""sorted_spmm: the MXU one-hot gather/scatter vs dense numpy references.
+
+Runs the Pallas kernels in interpret mode on CPU (conftest pins cpu), with
+small CHUNK/TILE geometry so worklist edge cases (gaps, boundary-shared
+tiles, heavy skew, sentinel padding) are all hit.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.ops import sorted_spmm as sp
+
+
+def _run(rows_np, n_rows, w=16, chunk=8, tile=32, seed=0):
+    p = len(rows_np)
+    dims = sp.spmm_dims(p, n_rows, chunk=chunk, tile=tile)
+    rng = np.random.default_rng(seed)
+    table = np.zeros((w, dims.n_kernel), np.float32)
+    table[:, :n_rows] = rng.normal(0, 1, (w, n_rows)).astype(np.float32)
+    payload = rng.normal(0, 1, (w, p)).astype(np.float32)
+
+    rows = jnp.asarray(rows_np, jnp.int32)
+    rows2d, perm, inv_perm, ch, tl, fg, fs = sp.build_plan(rows, dims)
+
+    # permutation sanity
+    assert np.array_equal(np.asarray(rows)[np.asarray(perm)],
+                          np.asarray(rows2d).reshape(-1)[:p])
+    assert np.array_equal(np.asarray(perm)[np.asarray(inv_perm)],
+                          np.arange(p))
+
+    g = sp.gather_sorted(jnp.asarray(table), rows2d, ch, tl, fg, dims,
+                         interpret=True)
+    g_canon = np.asarray(g)[:, :p][:, np.asarray(inv_perm)]
+    np.testing.assert_allclose(g_canon, table[:, rows_np], atol=1e-4,
+                               rtol=1e-4)
+
+    pay_sorted = payload[:, np.asarray(perm)]
+    pay_pad = np.zeros((w, dims.p_pad), np.float32)
+    pay_pad[:, :p] = pay_sorted
+    d = sp.scatter_add_sorted(jnp.asarray(pay_pad), rows2d, ch, tl, fs,
+                              dims, interpret=True)
+    ref = np.zeros((w, dims.n_kernel), np.float32)
+    np.add.at(ref.T, rows_np, payload.T)
+    np.testing.assert_allclose(np.asarray(d)[:, :n_rows], ref[:, :n_rows],
+                               atol=1e-3, rtol=1e-4)
+    # untouched rows must be exactly zero (optimizer masks depend on it)
+    untouched = np.setdiff1d(np.arange(n_rows), rows_np)
+    assert np.all(np.asarray(d)[:, untouched] == 0.0)
+
+
+def test_uniform_random():
+    rng = np.random.default_rng(1)
+    _run(rng.integers(0, 200, 300).astype(np.int32), 200)
+
+
+def test_heavy_skew_single_row():
+    rows = np.full(300, 7, np.int32)  # every occurrence on one row
+    _run(rows, 200)
+
+
+def test_skew_two_extremes():
+    rows = np.concatenate([np.zeros(150, np.int32),
+                           np.full(150, 199, np.int32)])
+    _run(rows, 200)
+
+
+def test_sparse_gaps():
+    # few occurrences scattered over a big table -> inter-chunk tile gaps
+    rows = np.array([3, 500, 501, 1999], np.int32)
+    _run(rows, 2000)
+
+
+def test_tiny_batch():
+    _run(np.array([5], np.int32), 64)
+
+
+def test_unsorted_input_order():
+    rng = np.random.default_rng(3)
+    rows = rng.permutation(np.repeat(np.arange(50, dtype=np.int32), 4))
+    _run(rows, 64)
+
+
+def test_non_multiple_sizes():
+    # p not multiple of chunk, n_rows not multiple of tile
+    rng = np.random.default_rng(4)
+    _run(rng.integers(0, 77, 59).astype(np.int32), 77, chunk=8, tile=32)
