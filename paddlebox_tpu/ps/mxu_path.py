@@ -1,0 +1,138 @@
+"""MXU sparse step path: pull/pool and push/update via sorted_spmm kernels.
+
+Third-generation hot path (v1 `embedding.py` gathers → v2 `fast_path.py`
+tiling-aware scatters → v3 this): the per-batch embedding traffic runs
+through the sorted one-hot-matmul kernels (ops/sorted_spmm.py), which turn
+TPU's serial gather/scatter into MXU block-sparse matmuls.  The optimizer
+is the unchanged full-table `ps.optimizer.apply_push` — the scatter kernel
+materializes the same merged per-row accumulators (`g_show`, `g_click`,
+`g_embed`, `g_embedx`, occurrence count, slot) the v1 path built with
+`.at[].add`, so every optimizer rule (adagrad / shared_adam / naive) works
+and semantics match optimizer.cuh.h exactly (up to f32 summation order;
+the kernels' hi/lo bf16 split carries ~1e-5 relative error).
+
+≙ reference hot path: PullSparseCaseGPU + CopyForPull
+(box_wrapper_impl.h:25, box_wrapper.cu:945), PushMergeCopy merge-by-key
+(box_wrapper.cu:417), HashTable::update (hashtable_kernel.cu).
+
+Layout notes: occurrence order is canonical [S, L, B] flattened; the plan's
+`perm`/`inv_perm` move between canonical and sorted domains (one XLA row
+gather each way, the only serial-ish ops left, ~2.6ms at 426k rows).  The
+pull table is feature-major [12, n_kernel] (rows: show, click, embed_w,
+mf×D, mf_size) so kernel blocks tile perfectly and the build is 12 row
+writes, not an [N, D] relayout.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.config import SparseSGDConfig
+from paddlebox_tpu.ops import sorted_spmm as sp
+from paddlebox_tpu.ps import optimizer as sparse_opt
+
+
+def make_dims(num_occurrences: int, num_rows: int) -> sp.SpmmDims:
+    return sp.spmm_dims(num_occurrences, num_rows)
+
+
+def build_plan(idx_slb: jnp.ndarray, dims: sp.SpmmDims):
+    """idx_slb [S, L, B] pass rows (0 = reserved/padding row)."""
+    return sp.build_plan(idx_slb.reshape(-1), dims)
+
+
+def _pull_table(ws: Dict[str, jnp.ndarray], dims: sp.SpmmDims) -> jnp.ndarray:
+    """Feature-major pull view [3 + D + 1, n_kernel]."""
+    n = ws["show"].shape[0]
+    d = ws["mf"].shape[1]
+    tab = jnp.zeros((3 + d + 1, dims.n_kernel), jnp.float32)
+    tab = tab.at[0, :n].set(ws["show"])
+    tab = tab.at[1, :n].set(ws["click"])
+    tab = tab.at[2, :n].set(ws["embed_w"])
+    tab = tab.at[3:3 + d, :n].set(ws["mf"].T)
+    tab = tab.at[3 + d, :n].set(ws["mf_size"].astype(jnp.float32))
+    return tab
+
+
+def pull_pool_cvm(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
+                  shape_slb: Tuple[int, int, int], use_cvm: bool = True,
+                  interpret: bool = False) -> jnp.ndarray:
+    """Fused pull + seqpool + CVM → pooled [B, S, 3 + D].
+
+    Row 0 and the sentinel tile hold zeros, so padding occurrences and
+    unseen keys contribute nothing — no length mask needed on the pull side.
+    """
+    s, l, b = shape_slb
+    d = ws["mf"].shape[1]
+    rows2d, perm, inv_perm, ch, tl, fg, fs = plan
+    tab = _pull_table(ws, dims)
+    g = sp.gather_sorted(tab, rows2d, ch, tl, fg, dims,
+                         interpret=interpret)              # [12, p_pad]
+    v = jnp.take(g.T[:dims.p], inv_perm, axis=0)           # canonical [p,12]
+    v = v.reshape(s, l, b, 3 + d + 1)
+    created = (v[..., 3 + d:] > 0).astype(v.dtype)         # [S,L,B,1]
+    show = jnp.sum(v[..., 0], axis=1)                      # [S, B]
+    click = jnp.sum(v[..., 1], axis=1)
+    w = jnp.sum(v[..., 2], axis=1)
+    mf = jnp.sum(v[..., 3:3 + d] * created, axis=1)        # [S, B, D]
+    if use_cvm:
+        show_t = jnp.log(show + 1.0)
+        click_t = jnp.log(click + 1.0) - show_t
+    else:
+        show_t, click_t = show, click
+    head = jnp.stack([show_t, click_t, w], axis=-1)        # [S, B, 3]
+    pooled = jnp.concatenate([head, mf], axis=-1)
+    return jnp.transpose(pooled, (1, 0, 2))                # [B, S, E]
+
+
+def push_and_update(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
+                    idx_slb: jnp.ndarray, d_pooled: jnp.ndarray,
+                    ins_cvm: jnp.ndarray, slot_ids: jnp.ndarray,
+                    cfg: SparseSGDConfig,
+                    interpret: bool = False) -> Dict[str, jnp.ndarray]:
+    """Merged push + sparse optimizer.
+
+    d_pooled [B, S, 3+D] — cols 0,1 are ignored and replaced by the
+    instance cvm (reference push semantics, box_wrapper_impl.h:373);
+    ins_cvm [B, 2]; slot_ids [S].
+    """
+    s, l, b = idx_slb.shape
+    d = ws["mf"].shape[1]
+    n = ws["show"].shape[0]
+    rows2d, perm, inv_perm, ch, tl, fg, fs = plan
+
+    # canonical per-occurrence payload [S, L, B, D+5]:
+    #   g_show, g_click, g_embed, g_mf x D, count, slot
+    g_show = jnp.broadcast_to(ins_cvm[None, None, :, 0], (s, l, b))
+    g_click = jnp.broadcast_to(ins_cvm[None, None, :, 1], (s, l, b))
+    d_w = jnp.transpose(d_pooled[:, :, 2], (1, 0))         # [S, B]
+    g_embed = jnp.broadcast_to(d_w[:, None, :], (s, l, b))
+    d_mf = jnp.transpose(d_pooled[:, :, 3:], (1, 0, 2))    # [S, B, D]
+    g_mf = jnp.broadcast_to(d_mf[:, None], (s, l, b, d))
+    ones = jnp.ones((s, l, b), jnp.float32)
+    slot_col = jnp.broadcast_to(
+        slot_ids.astype(jnp.float32)[:, None, None], (s, l, b))
+    payload = jnp.concatenate(
+        [jnp.stack([g_show, g_click, g_embed], axis=-1), g_mf,
+         jnp.stack([ones, slot_col], axis=-1)], axis=-1)   # [S,L,B,D+5]
+    flat = payload.reshape(dims.p, d + 5)
+    srt = jnp.take(flat, perm, axis=0)                     # sorted domain
+    srt = jnp.concatenate(
+        [srt, jnp.zeros((dims.p_pad - dims.p, d + 5), jnp.float32)])
+    delta = sp.scatter_add_sorted(srt.T, rows2d, ch, tl, fs, dims,
+                                  interpret=interpret)     # [D+5, n_kernel]
+
+    cnt = delta[d + 3, :n]
+    safe_cnt = jnp.maximum(cnt, 1.0)
+    acc = {
+        "g_show": delta[0, :n],
+        "g_click": delta[1, :n],
+        "g_embed": delta[2, :n],
+        "g_embedx": delta[3:3 + d, :n].T,
+        # all occurrences of a key share its slot, so mean == the value
+        "slot": jnp.rint(delta[d + 4, :n] / safe_cnt).astype(jnp.int32),
+    }
+    return sparse_opt.apply_push(ws, acc, cfg)

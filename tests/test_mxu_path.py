@@ -1,0 +1,119 @@
+"""mxu_path (sorted-SpMM step) vs fast_path / reference path equivalence.
+
+Same working set + batch through all three sparse pipelines must produce
+matching pooled outputs and matching post-push working sets (up to the
+kernels' hi/lo bf16 summation error, ~1e-5 relative).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.config import SparseSGDConfig
+from paddlebox_tpu.ps import embedding, fast_path, feature_value as fv
+from paddlebox_tpu.ps import mxu_path
+from paddlebox_tpu.ps import optimizer as sparse_opt
+
+
+def _make_ws(n_rows, mf_dim, seed=0, created_frac=0.7):
+    rng = np.random.default_rng(seed)
+    host = fv.default_rows(n_rows - 1, mf_dim, rng, 1e-2)
+    host["show"][:] = rng.integers(1, 50, n_rows - 1).astype(np.float32)
+    host["click"][:] = rng.integers(0, 5, n_rows - 1).astype(np.float32)
+    host["mf_size"][:] = np.where(rng.random(n_rows - 1) < created_frac,
+                                  mf_dim, 0)
+    host["embed_g2sum"][:] = rng.random(n_rows - 1).astype(np.float32)
+    host["mf_g2sum"][:] = rng.random(n_rows - 1).astype(np.float32)
+    return embedding.build_working_set(host, mf_dim, pad_to=n_rows)
+
+
+def _batch(n_rows, S, L, B, seed=1):
+    rng = np.random.default_rng(seed)
+    # slot-disjoint key ranges (matches real data: a feasign embeds its
+    # slot id) — the per-row slot accumulator is scatter-max in the v1
+    # path but count-normalized mean in the mxu path; they agree exactly
+    # when a row is touched by one slot only
+    per = (n_rows - 1) // S
+    idx = np.zeros((S, L, B), np.int32)
+    for s_ in range(S):
+        idx[s_] = 1 + s_ * per + rng.integers(0, per, (L, B))
+    idx[rng.random((S, L, B)) < 0.1] = 0  # sprinkle unseen keys
+    lengths = rng.integers(0, L + 1, (S, B)).astype(np.int32)
+    # enforce the packer convention: positions >= length carry row 0
+    for s in range(S):
+        for b in range(B):
+            idx[s, lengths[s, b]:, b] = 0
+    d_pooled = rng.normal(0, 1, (B, S, 3 + 4)).astype(np.float32)
+    ins_cvm = np.stack([np.ones(B), rng.integers(0, 2, B)], 1).astype(
+        np.float32)
+    slot_ids = (100 + np.arange(S)).astype(np.int32)
+    return (jnp.asarray(idx), jnp.asarray(lengths), jnp.asarray(d_pooled),
+            jnp.asarray(ins_cvm), jnp.asarray(slot_ids))
+
+
+@pytest.mark.parametrize("use_cvm", [True, False])
+def test_pull_matches_fast_path(use_cvm):
+    n, D, S, L, B = 300, 4, 5, 3, 16
+    ws = _make_ws(n, D)
+    idx, lengths, d_pooled, ins_cvm, slot_ids = _batch(n, S, L, B)
+    dims = mxu_path.make_dims(S * L * B, n)
+    plan = mxu_path.build_plan(idx, dims)
+    got = mxu_path.pull_pool_cvm(ws, plan, dims, (S, L, B), use_cvm,
+                                 interpret=True)
+    want = fast_path.pull_pool_cvm(ws, idx, lengths, use_cvm)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-3, rtol=1e-4)
+
+
+def test_push_matches_fast_path_adagrad():
+    n, D, S, L, B = 300, 4, 5, 3, 16
+    cfg = SparseSGDConfig(mf_create_thresholds=5.0)
+    ws = _make_ws(n, D)
+    idx, lengths, d_pooled, ins_cvm, slot_ids = _batch(n, S, L, B)
+    dims = mxu_path.make_dims(S * L * B, n)
+    plan = mxu_path.build_plan(idx, dims)
+    got = mxu_path.push_and_update(ws, plan, dims, idx, d_pooled, ins_cvm,
+                                   slot_ids, cfg, interpret=True)
+    want = fast_path.push_and_update(ws, idx, lengths, d_pooled, ins_cvm,
+                                     slot_ids, cfg)
+    for k in want:
+        np.testing.assert_allclose(
+            np.asarray(got[k]), np.asarray(want[k]), atol=2e-3, rtol=2e-4,
+            err_msg=f"field {k}")
+
+
+def test_push_matches_reference_path_all_optimizers():
+    # the mxu accumulators must equal embedding.push_sparse_grads's, so any
+    # optimizer rule (not just adagrad) composes with them
+    n, D, S, L, B = 200, 4, 4, 2, 8
+    for opt in ("adagrad", "naive"):
+        cfg = SparseSGDConfig(optimizer=opt, mf_create_thresholds=5.0)
+        ws = _make_ws(n, D, seed=3)
+        idx, lengths, d_pooled, ins_cvm, slot_ids = _batch(n, S, L, B, seed=4)
+        dims = mxu_path.make_dims(S * L * B, n)
+        plan = mxu_path.build_plan(idx, dims)
+        got = mxu_path.push_and_update(ws, plan, dims, idx, d_pooled,
+                                       ins_cvm, slot_ids, cfg,
+                                       interpret=True)
+        # reference accumulators expect grads [S,B,L,3+D] with the cvm cols
+        # replaced by the instance cvm and key-masked
+        m = (np.arange(L)[None, :, None] <
+             np.asarray(lengths)[:, None, :]).astype(np.float32)  # [S,L,B]
+        g = np.zeros((S, B, L, 3 + D), np.float32)
+        g[..., 0] = (np.asarray(ins_cvm)[None, :, 0][..., None] *
+                     m.transpose(0, 2, 1))
+        g[..., 1] = (np.asarray(ins_cvm)[None, :, 1][..., None] *
+                     m.transpose(0, 2, 1))
+        g[..., 2] = (np.asarray(d_pooled)[:, :, 2].T[:, :, None] *
+                     m.transpose(0, 2, 1))
+        g[..., 3:] = (np.asarray(d_pooled)[:, :, 3:].transpose(1, 0, 2)
+                      [:, :, None, :] * m.transpose(0, 2, 1)[..., None])
+        idx_sbl = jnp.transpose(idx, (0, 2, 1))  # [S,B,L]
+        acc = embedding.push_sparse_grads(ws, idx_sbl, jnp.asarray(g),
+                                          jnp.asarray(slot_ids))
+        want = sparse_opt.apply_push(ws, acc, cfg)
+        for k in want:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want[k]), atol=2e-3,
+                rtol=2e-4, err_msg=f"{opt}/{k}")
