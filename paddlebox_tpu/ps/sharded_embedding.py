@@ -64,3 +64,70 @@ def push_rows_sharded(table_local: jnp.ndarray, idx_local: jnp.ndarray,
     # row 0 of device 0 is the global reserved row; non-owned writes go to
     # local row 0 with zero grads, so they are no-ops
     return table_local.at[safe].add(g_masked)
+
+
+# ---------------------------------------------------------------------------
+# MXU-kernel variants: same collectives, but the per-device random access
+# runs through the sorted one-hot-matmul kernels (ops/sorted_spmm.py)
+# instead of XLA's serial gather/scatter — the multi-chip version of the
+# single-chip mxu path (ps/mxu_path.py).  Out-of-block ids land in the
+# local sentinel tile, so ownership masking falls out of the kernel
+# geometry for free (gathers read zeros, scatters write a discarded tile).
+# ---------------------------------------------------------------------------
+
+def _local_plan(idx_local: jnp.ndarray, rows_loc: int, axis: str):
+    """all_gather the ids and localize to this device's row block: ids
+    outside [me*rows_loc, (me+1)*rows_loc) park at the sentinel tile, so
+    ownership masking falls out of the kernel geometry."""
+    from paddlebox_tpu.ops import sorted_spmm as sp
+    me = lax.axis_index(axis)
+    idx_all = lax.all_gather(idx_local, axis, axis=0, tiled=True)   # [P]
+    dims = sp.spmm_dims(idx_all.shape[0], rows_loc)
+    local = idx_all - me * rows_loc
+    local = jnp.where((local >= 0) & (local < rows_loc), local,
+                      dims.sentinel)
+    return dims, sp.build_plan(local, dims)
+
+
+def pull_rows_sharded_mxu(table_fm_local: jnp.ndarray,
+                          idx_local: jnp.ndarray, axis: str,
+                          interpret: bool = False) -> jnp.ndarray:
+    """Inside shard_map.  table_fm_local: [W, rows_loc] feature-major block;
+    idx_local: [P_loc] global row ids.  → [W, P_loc] pulled values.
+
+    ≙ HeterComm pull_merge_sparse (heter_comm_inl.h:1296) with the shard
+    walk replaced by all_gather(ids) + local SpMM + psum_scatter(values).
+    """
+    from paddlebox_tpu.ops import sorted_spmm as sp
+    rows_loc = table_fm_local.shape[1]
+    dims, plan = _local_plan(idx_local, rows_loc, axis)
+    rows2d, perm, inv_perm, ch, tl, fg, fs = plan
+    # pad the local block to kernel geometry (sentinel tile = zeros)
+    tab = jnp.zeros((table_fm_local.shape[0], dims.n_kernel),
+                    table_fm_local.dtype)
+    tab = lax.dynamic_update_slice(tab, table_fm_local, (0, 0))
+    g = sp.gather_sorted(tab, rows2d, ch, tl, fg, dims,
+                         interpret=interpret)                   # [W, p_pad]
+    vals = jnp.take(g[:, :dims.p], inv_perm, axis=1)            # [W, P]
+    # requester receives its slice; only the owner contributed nonzero
+    return lax.psum_scatter(vals, axis, scatter_dimension=1, tiled=True)
+
+
+def push_rows_sharded_mxu(idx_local: jnp.ndarray,
+                          payload_local: jnp.ndarray, rows_loc: int,
+                          axis: str, interpret: bool = False) -> jnp.ndarray:
+    """Inside shard_map.  payload_local: [W, P_loc] per-occurrence push
+    values.  → merged per-row accumulators [W, rows_loc] for this device's
+    block (feed to the local optimizer, ≙ gather_one_node_grad + local
+    merge, heter_comm_inl.h:2027)."""
+    from paddlebox_tpu.ops import sorted_spmm as sp
+    dims, plan = _local_plan(idx_local, rows_loc, axis)
+    rows2d, perm, inv_perm, ch, tl, fg, fs = plan
+    pay_all = lax.all_gather(payload_local, axis, axis=1, tiled=True)
+    srt = jnp.take(pay_all, perm, axis=1)
+    srt = jnp.concatenate(
+        [srt, jnp.zeros((pay_all.shape[0], dims.p_pad - dims.p),
+                        pay_all.dtype)], axis=1)
+    delta = sp.scatter_add_sorted(srt, rows2d, ch, tl, fs, dims,
+                                  interpret=interpret)
+    return delta[:, :rows_loc]

@@ -85,23 +85,37 @@ class SparseTrainer:
             "engine pass lifecycle must run before building the step " \
             "(begin_feed_pass/add_keys/end_feed_pass/begin_pass)"
         path = self.sparse_path
+        has_ex = "mf_ex" in self.engine.ws
+        is_adagrad = self.engine.config.sgd.optimizer == "adagrad"
         if path == "auto":
             if not self.fast_path:
                 # fast_path=False is the documented escape hatch to the
                 # numerically-exact reference step — honor it
                 path = "reference"
-            elif "mf_ex" not in self.engine.ws:
-                # mxu path composes with every optimizer rule; only the
-                # NNCross/extended tables still take the older paths
+            elif not has_ex and self.topology is None:
+                # mxu path composes with every optimizer rule, but its
+                # Pallas kernels are single-chip (GSPMD cannot partition
+                # them); sharded meshes take the partitionable paths —
+                # the shard_map variants live in ps/sharded_embedding.py
                 path = "mxu"
-            elif self.engine.config.sgd.optimizer == "adagrad":
+            elif is_adagrad:
                 path = "fast"
             else:
                 path = "reference"
         if path == "mxu":
+            if has_ex:
+                raise ValueError(
+                    "sparse_path='mxu' does not support extended (mf_ex) "
+                    "tables — use 'fast' or 'reference'")
             return self._build_step_mxu()
-        if path == "fast" and self.engine.config.sgd.optimizer == "adagrad":
+        if path == "fast":
+            if not is_adagrad:
+                raise ValueError(
+                    "sparse_path='fast' implements the adagrad rule only "
+                    f"(got {self.engine.config.sgd.optimizer!r})")
             return self._build_step_fast()
+        if path != "reference":
+            raise ValueError(f"unknown sparse_path {path!r}")
         return self._build_step_reference()
 
     def _pooled_dense_half(self):

@@ -16,9 +16,9 @@ from paddlebox_tpu.ps import mxu_path
 from paddlebox_tpu.ps import optimizer as sparse_opt
 
 
-def _make_ws(n_rows, mf_dim, seed=0, created_frac=0.7):
+def _make_ws(n_rows, mf_dim, seed=0, created_frac=0.7, adam=False):
     rng = np.random.default_rng(seed)
-    host = fv.default_rows(n_rows - 1, mf_dim, rng, 1e-2)
+    host = fv.default_rows(n_rows - 1, mf_dim, rng, 1e-2, adam=adam)
     host["show"][:] = rng.integers(1, 50, n_rows - 1).astype(np.float32)
     host["click"][:] = rng.integers(0, 5, n_rows - 1).astype(np.float32)
     host["mf_size"][:] = np.where(rng.random(n_rows - 1) < created_frac,
@@ -87,9 +87,9 @@ def test_push_matches_reference_path_all_optimizers():
     # the mxu accumulators must equal embedding.push_sparse_grads's, so any
     # optimizer rule (not just adagrad) composes with them
     n, D, S, L, B = 200, 4, 4, 2, 8
-    for opt in ("adagrad", "naive"):
+    for opt in ("adagrad", "naive", "shared_adam"):
         cfg = SparseSGDConfig(optimizer=opt, mf_create_thresholds=5.0)
-        ws = _make_ws(n, D, seed=3)
+        ws = _make_ws(n, D, seed=3, adam=opt == "shared_adam")
         idx, lengths, d_pooled, ins_cvm, slot_ids = _batch(n, S, L, B, seed=4)
         dims = mxu_path.make_dims(S * L * B, n)
         plan = mxu_path.build_plan(idx, dims)

@@ -66,3 +66,49 @@ def test_pull_push_roundtrip_train_signal(topo):
     g_ref = 2.0 * (table[idx] - target)
     want = table.at[idx].add(-0.1 * g_ref)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# MXU-kernel sharded variants
+# ---------------------------------------------------------------------------
+
+def test_pull_push_sharded_mxu_matches_dense():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddlebox_tpu.ps.sharded_embedding import (pull_rows_sharded_mxu,
+                                                    push_rows_sharded_mxu)
+
+    # check_vma=False: pallas_call out_shapes carry no vma annotation
+    def shard_map(f, mesh, in_specs, out_specs):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+
+    n_dev = 8
+    devs = jax.devices()[:n_dev]
+    mesh = Mesh(np.array(devs), ("tbl",))
+    W, rows_loc = 16, 64
+    N = rows_loc * n_dev
+    P_loc = 24
+    rng = np.random.default_rng(0)
+    table = rng.normal(0, 1, (W, N)).astype(np.float32)
+    idx = rng.integers(0, N, (P_loc * n_dev,)).astype(np.int32)
+    payload = rng.normal(0, 1, (W, P_loc * n_dev)).astype(np.float32)
+
+    pull = shard_map(
+        lambda t, i: pull_rows_sharded_mxu(t, i, "tbl", interpret=True),
+        mesh=mesh, in_specs=(P(None, "tbl"), P("tbl")),
+        out_specs=P(None, "tbl"))
+    got = np.asarray(pull(jnp.asarray(table), jnp.asarray(idx)))
+    np.testing.assert_allclose(got, table[:, idx], atol=1e-3, rtol=1e-4)
+
+    push = shard_map(
+        lambda i, g: push_rows_sharded_mxu(i, g, rows_loc, "tbl",
+                                           interpret=True),
+        mesh=mesh, in_specs=(P("tbl"), P(None, "tbl")),
+        out_specs=P(None, "tbl"))
+    acc = np.asarray(push(jnp.asarray(idx), jnp.asarray(payload)))
+    ref = np.zeros((W, N), np.float32)
+    np.add.at(ref.T, idx, payload.T)
+    np.testing.assert_allclose(acc, ref, atol=1e-3, rtol=1e-4)
