@@ -118,6 +118,12 @@ class TrainerConfig:
     thread_num: int = 1
     dense_sync_mode: str = "allreduce"   # allreduce | async_table | sharded
     sync_weight_step: int = 1            # ≙ sync_weight_step
+    # adam hyper-params of the async dense table's update thread
+    # (≙ BoxPSAsynDenseTable's built-in rule, boxps_worker.cc:260-330)
+    async_dense_learning_rate: float = 1e-3
+    async_dense_beta1: float = 0.9
+    async_dense_beta2: float = 0.999
+    async_dense_eps: float = 1e-8
     dump_fields: Tuple[str, ...] = ()
     dump_path: str = ""
 

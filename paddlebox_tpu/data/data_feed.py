@@ -155,3 +155,60 @@ def make_parser(config: DataFeedConfig, parse_ins_id: bool = False,
         except Exception:
             pass
     return SlotParser(config, parse_ins_id, parse_logkey_)
+
+
+class ParserPluginManager:
+    """Pluggable per-format parsers — ≙ CustomParser + DLManager
+    (data_feed.h:446,682): production feeds load site-specific parser
+    implementations by name at run time instead of baking every data format
+    into the framework.
+
+    Two plugin kinds, keyed by a spec string (cached like DLManager::load):
+      * ``"pkg.module:factory"`` — importable python factory called as
+        ``factory(config) -> parser`` where ``parser.parse_block(lines)``
+        returns a SlotRecordBlock (covers the reference's ISlotParser
+        surface, data_feed.h:1964);
+      * ``"/path/libplugin.so:symbol"`` — a C shared library exposing the
+        native block-parser ABI of native/slot_parser.cc under ``symbol``
+        (dlopen'd once, ≙ DLManager caching).
+    """
+
+    def __init__(self):
+        self._cache = {}
+
+    def load(self, spec: str, config: DataFeedConfig):
+        if spec in self._cache:
+            factory = self._cache[spec]
+            return factory(config)
+        target, _, name = spec.partition(":")
+        if target.endswith(".so"):
+            import ctypes
+
+            lib = ctypes.CDLL(target)  # dlopen once; symbols resolved below
+            from paddlebox_tpu.native.slot_parser import NativeSlotParser
+
+            def factory(cfg, _lib=lib, _sym=name or "pbox_parse_block"):
+                p = NativeSlotParser(cfg)
+                p._lib = _lib
+                p._entry = _sym
+                return p
+        else:
+            import importlib
+
+            mod = importlib.import_module(target)
+            fn = getattr(mod, name or "create_parser")
+
+            def factory(cfg, _fn=fn):
+                return _fn(cfg)
+
+        self._cache[spec] = factory
+        return factory(config)
+
+
+_plugin_manager = ParserPluginManager()
+
+
+def load_parser_plugin(spec: str, config: DataFeedConfig):
+    """Module-level convenience over a process-wide manager (≙ the global
+    DLManager instance reached through dlmanager(), data_feed.h:707)."""
+    return _plugin_manager.load(spec, config)

@@ -108,9 +108,13 @@ class BoxPSDataset:
 
     def wait_preload_done(self) -> None:
         self.dataset.wait_preload_done()
+        # readers are done feeding keys: kick the background working-set
+        # build so it overlaps any still-running training pass
+        self.engine.end_feed_pass(async_build=True)
 
     def begin_pass(self) -> None:
-        self.engine.end_feed_pass()
+        if self.engine._feeding:
+            self.engine.end_feed_pass()
         self.engine.begin_pass()
 
     def end_pass(self, need_save_delta: bool = False,

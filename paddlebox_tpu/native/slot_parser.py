@@ -62,12 +62,27 @@ class NativeSlotParser:
         self._is_float = np.array(
             [1 if s.dtype == "float" else 0 for s in config.slots], np.uint8)
 
+    # plugin .so overrides (ParserPluginManager sets these to dlopen'd
+    # site-specific parsers exposing the same ABI)
+    _lib = None
+    _entry = "pbox_parse_block"
+
     def parse_block(self, lines) -> SlotRecordBlock:
+        # accessors (slot_total/fill_*) always come from the canonical lib
+        # — a plugin .so only overrides the *parse* entry and must return a
+        # handle compatible with the canonical block layout
         lib = _load()
+        entry = getattr(self._lib, self._entry) \
+            if self._lib is not None else lib.pbox_parse_block
+        if self._lib is not None:
+            # ctypes defaults restype to c_int (truncates the handle
+            # pointer) — stamp the block-parser ABI on the plugin symbol
+            entry.restype = ctypes.c_void_p
+            entry.argtypes = lib.pbox_parse_block.argtypes
         buf = ("\n".join(lines) + "\n").encode()
         n_rec = ctypes.c_int64(0)
         status = ctypes.c_int32(0)
-        handle = lib.pbox_parse_block(
+        handle = entry(
             buf, len(buf), len(self.config.slots),
             self._is_float.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             int(self.parse_ins_id), int(self.parse_logkey),

@@ -70,6 +70,97 @@ class PipelineRunner:
         return lax.psum(out * mask, axis)
 
 
+class PipelineRunner1F1B:
+    """1F1B schedule (≙ SectionWorker schedule_mode=1, section_worker.cc:149
+    and dygraph PipelineParallel.forward_backward_pipeline,
+    pipeline_parallel.py:82): activation stash bounded by O(pp) — constant
+    in the microbatch count — unlike the autodiff GPipe runner above whose
+    scan saves every tick.
+
+    SPMD formulation: one scan over T = M + 2*pp - 2 ticks; at tick t stage
+    s forwards microbatch ``t - s`` and backwards microbatch
+    ``t - (2*pp - 2 - s)`` (the last stage backs a microbatch immediately
+    after forwarding it).  Backward recomputes the stage forward from the
+    stashed *input* (recompute-in-backward, the memory-cheap 1F1B variant),
+    so the stash holds at most 2*pp microbatch inputs.  Activations hop via
+    ppermute(+1), gradients via ppermute(-1).
+
+    Because the schedule runs its own backward, this runner is not meant to
+    be differentiated — it *returns* (mean loss, per-stage param grads).
+    """
+
+    def __init__(self, stage_fn: Callable, loss_fn: Callable, n_stages: int,
+                 axis: str = "pp"):
+        self.stage_fn = stage_fn      # (stage_params, x) -> y, same shape
+        self.loss_fn = loss_fn        # (y, target_mb) -> scalar (sum-able)
+        self.n_stages = n_stages
+        self.axis = axis
+
+    def __call__(self, params_local, microbatches: jnp.ndarray,
+                 targets: jnp.ndarray):
+        """Inside shard_map.  params_local: [1, ...] stage params slice;
+        microbatches [M, Bm, ...], targets [M, ...] (both replicated).
+        → (mean loss over microbatches, param grads [1, ...])."""
+        pp, axis = self.n_stages, self.axis
+        s = lax.axis_index(axis)
+        M = microbatches.shape[0]
+        ticks = M + 2 * pp - 2
+        cap = 2 * pp                          # stash slots (≥ max in-flight)
+        perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+        perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
+        params = jax.tree.map(lambda a: a[0], params_local)
+
+        x_shape = microbatches[0]
+        stash0 = jnp.zeros((cap,) + x_shape.shape, x_shape.dtype)
+        g_acc0 = jax.tree.map(jnp.zeros_like, params)
+
+        def tick(carry, t):
+            y_send, g_send, stash, g_acc, loss_acc = carry
+            x_in = lax.ppermute(y_send, axis, perm_fwd)
+            g_in = lax.ppermute(g_send, axis, perm_bwd)
+
+            m_f = t - s
+            m_b = t - (2 * pp - 2 - s)
+            do_f = (m_f >= 0) & (m_f < M)
+            do_b = (m_b >= 0) & (m_b < M)
+
+            # ---- forward of microbatch m_f --------------------------------
+            feed = microbatches[jnp.clip(m_f, 0, M - 1)]
+            x_f = jnp.where(s == 0, feed, x_in)
+            y_f = self.stage_fn(params, x_f)
+            y_send_new = jnp.where(do_f, y_f, y_send)
+            stash = lax.dynamic_update_index_in_dim(
+                stash, jnp.where(do_f, x_f, stash[jnp.clip(m_f, 0, M - 1)
+                                                  % cap]),
+                jnp.clip(m_f, 0, M - 1) % cap, 0)
+
+            # ---- backward of microbatch m_b (recompute from stashed x) ----
+            mb_c = jnp.clip(m_b, 0, M - 1)
+            x_b = stash[mb_c % cap]
+            y_b, pull = jax.vjp(self.stage_fn, params, x_b)
+            tgt = targets[mb_c]
+            loss_val, dy_last = jax.value_and_grad(self.loss_fn)(y_b, tgt)
+            dy = jnp.where(s == pp - 1, dy_last, g_in)
+            d_params, d_x = pull(dy)
+            g_acc = jax.tree.map(
+                lambda a, d: a + jnp.where(do_b, d, jnp.zeros_like(d)),
+                g_acc, d_params)
+            g_send_new = jnp.where(do_b, d_x, g_send)
+            loss_acc = loss_acc + jnp.where(
+                do_b & (s == pp - 1), loss_val, 0.0)
+
+            return (y_send_new, g_send_new, stash, g_acc, loss_acc), None
+
+        init = (jnp.zeros_like(x_shape), jnp.zeros_like(x_shape), stash0,
+                g_acc0, jnp.float32(0.0))
+        (y_send, g_send, stash, g_acc, loss_acc), _ = lax.scan(
+            tick, init, jnp.arange(ticks))
+        # loss lives on the last stage; replicate it
+        loss = lax.psum(jnp.where(s == pp - 1, loss_acc, 0.0), axis) / M
+        grads = jax.tree.map(lambda a: a[None] / M, g_acc)
+        return loss, grads
+
+
 def stack_stage_params(per_stage_params: Sequence) -> object:
     """[pp] list of identical pytrees → stacked pytree with leading stage
     dim (shard over pp with PartitionSpec('pp', ...))."""

@@ -62,11 +62,34 @@ ADAM_FIELDS = (
 )
 
 
-def empty_soa(n: int, mf_dim: int, expand_dim: int = 0, adam: bool = False
-              ) -> Dict[str, np.ndarray]:
+# per-dim optimizer state (≙ CPU SparseAdamSGDRule sparse_sgd_rule.h:126 /
+# GPU SparseAdamOptimizer optimizer.cuh.h:148, and StdAdaGradSGDRule
+# sparse_sgd_rule.h:109): embedx moments/g2sum per dimension
+DIM_ADAM_FIELDS = (
+    ("mf_gsum_d", np.float32, ("D",)),
+    ("mf_g2sum_d", np.float32, ("D",)),
+)
+DIM_ADAGRAD_FIELDS = (
+    ("mf_g2sum_d", np.float32, ("D",)),
+)
+
+
+def state_fields(optimizer: str):
+    """Extra per-row state fields an optimizer rule needs."""
+    return {
+        "shared_adam": ADAM_FIELDS,
+        "adam": ADAM_FIELDS + DIM_ADAM_FIELDS,
+        "std_adagrad": DIM_ADAGRAD_FIELDS,
+    }.get(optimizer, ())
+
+
+def empty_soa(n: int, mf_dim: int, expand_dim: int = 0, adam: bool = False,
+              optimizer: str = "") -> Dict[str, np.ndarray]:
     out = {}
+    extra = state_fields(optimizer) if optimizer else \
+        (ADAM_FIELDS if adam else ())
     fields = HOST_FIELDS + (EXPAND_FIELDS if expand_dim > 0 else ()) \
-        + (ADAM_FIELDS if adam else ())
+        + extra
     for name, dtype, suffix in fields:
         shape = (n,) + tuple(
             mf_dim if s == "D" else (expand_dim if s == "E" else s)
@@ -78,8 +101,8 @@ def empty_soa(n: int, mf_dim: int, expand_dim: int = 0, adam: bool = False
 def default_rows(n: int, mf_dim: int, rng: np.random.Generator,
                  mf_initial_range: float, initial_range: float = 0.0,
                  expand_dim: int = 0, adam: bool = False,
-                 beta1: float = 0.9, beta2: float = 0.999
-                 ) -> Dict[str, np.ndarray]:
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 optimizer: str = "") -> Dict[str, np.ndarray]:
     """Fresh feature rows for keys unseen by the host table.
 
     embed_w ~ U(-initial_range, initial_range) (CPU rule init; default range 0
@@ -87,7 +110,7 @@ def default_rows(n: int, mf_dim: int, rng: np.random.Generator,
     ~ U(0, mf_initial_range) (≙ curand_uniform * mf_initial_range,
     optimizer.cuh.h:119-121) which stays masked until mf_size > 0.
     """
-    soa = empty_soa(n, mf_dim, expand_dim, adam)
+    soa = empty_soa(n, mf_dim, expand_dim, adam, optimizer)
     if initial_range > 0:
         soa["embed_w"] = rng.uniform(
             -initial_range, initial_range, size=(n,)).astype(np.float32)
@@ -96,7 +119,7 @@ def default_rows(n: int, mf_dim: int, rng: np.random.Generator,
     if expand_dim > 0:
         soa["mf_ex"] = rng.uniform(
             0.0, mf_initial_range, size=(n, expand_dim)).astype(np.float32)
-    if adam:
+    if "embed_b1p" in soa:
         # fresh features start their beta-power trackers at the decay rates
         # (≙ creation init optimizer.cuh.h:436-441 / adam accessor InitValue)
         soa["embed_b1p"][:] = beta1

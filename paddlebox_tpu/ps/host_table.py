@@ -25,9 +25,11 @@ from paddlebox_tpu.ps import feature_value as fv
 
 
 class _Shard:
-    def __init__(self, mf_dim: int, expand_dim: int = 0, adam: bool = False):
+    def __init__(self, mf_dim: int, expand_dim: int = 0, adam: bool = False,
+                 optimizer: str = ""):
+        self.optimizer = optimizer
         self.keys = np.empty((0,), np.uint64)
-        self.soa = fv.empty_soa(0, mf_dim, expand_dim, adam)
+        self.soa = fv.empty_soa(0, mf_dim, expand_dim, adam, optimizer)
         self.mf_dim = mf_dim
         self.lock = threading.Lock()
 
@@ -72,8 +74,10 @@ class ShardedHostTable:
         self.mf_dim = config.embedding_dim
         self.expand_dim = config.expand_dim
         self.adam = config.sgd.optimizer in ("adam", "shared_adam")
+        self.optimizer = config.sgd.optimizer
         self.shard_num = config.shard_num
-        self._shards = [_Shard(self.mf_dim, self.expand_dim, self.adam)
+        self._shards = [_Shard(self.mf_dim, self.expand_dim, self.adam,
+                               self.optimizer)
                         for _ in range(self.shard_num)]
         self._rng = np.random.default_rng(seed)
 
@@ -95,18 +99,22 @@ class ShardedHostTable:
                               self.config.sgd.initial_range,
                               self.expand_dim, self.adam,
                               self.config.sgd.beta1_decay_rate,
-                              self.config.sgd.beta2_decay_rate)
+                              self.config.sgd.beta2_decay_rate,
+                              self.optimizer)
         sid = self._shard_ids(keys)
         for s, shard in enumerate(self._shards):
             sel = np.nonzero(sid == s)[0]
             if not len(sel):
                 continue
-            pos, found = shard.lookup(keys[sel])
-            hit = sel[found]
-            if len(hit):
-                src = pos[found]
-                for f, arr in shard.soa.items():
-                    out[f][hit] = arr[src]
+            # under the shard lock: the pipelined preload thread pulls
+            # concurrently with main-thread upserts that rebuild keys/soa
+            with shard.lock:
+                pos, found = shard.lookup(keys[sel])
+                hit = sel[found]
+                if len(hit):
+                    src = pos[found]
+                    for f, arr in shard.soa.items():
+                        out[f][hit] = arr[src]
         return out
 
     def bulk_write(self, keys: np.ndarray, soa: Dict[str, np.ndarray]) -> None:

@@ -49,15 +49,12 @@ def _adagrad_update(w, g2sum, g, scale, lr, initial_g2sum, min_bound,
             jnp.where(touched, g2sum + add_g2sum, g2sum))
 
 
-def sparse_adagrad_apply(ws: Dict[str, jnp.ndarray],
-                         acc: Dict[str, jnp.ndarray],
-                         cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
-    """One merged push → working-set update (≙ HashTable::update with
-    SparseAdagradOptimizer, hashtable_kernel.cu + optimizer.cuh.h:31)."""
+def _common_stats(ws, acc, cfg):
+    """Shared show/click/delta accumulation + touched mask (the common
+    prologue of every rule, ≙ optimizer.cuh.h:84-101)."""
     n = ws["show"].shape[0]
     row = jnp.arange(n)
     touched = (acc["g_show"] > 0) & (row != 0)
-
     show = jnp.where(touched, ws["show"] + acc["g_show"], ws["show"])
     click = jnp.where(touched, ws["click"] + acc["g_click"], ws["click"])
     delta = jnp.where(
@@ -65,6 +62,27 @@ def sparse_adagrad_apply(ws: Dict[str, jnp.ndarray],
         ws["delta_score"] + cfg.nonclk_coeff * (acc["g_show"] - acc["g_click"])
         + cfg.clk_coeff * acc["g_click"],
         ws["delta_score"])
+    return touched, show, click, delta
+
+
+def _mf_create(ws, cfg, touched, show, click, mf_dim):
+    """Lazy mf creation on the post-accumulation show/click
+    (optimizer.cuh.h:104-112); rows created this push keep their candidate
+    init (the reference returns right after initialization, :113-127)."""
+    score = cfg.nonclk_coeff * (show - click) + cfg.clk_coeff * click
+    create = touched & (ws["mf_size"] == 0) & \
+        (score >= cfg.mf_create_thresholds)
+    mf_size = jnp.where(create, mf_dim, ws["mf_size"])
+    mf_touched = touched & (ws["mf_size"] > 0)
+    return create, mf_size, mf_touched
+
+
+def sparse_adagrad_apply(ws: Dict[str, jnp.ndarray],
+                         acc: Dict[str, jnp.ndarray],
+                         cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
+    """One merged push → working-set update (≙ HashTable::update with
+    SparseAdagradOptimizer, hashtable_kernel.cu + optimizer.cuh.h:31)."""
+    touched, show, click, delta = _common_stats(ws, acc, cfg)
     slot = jnp.where(touched, acc["slot"], ws["slot"])
 
     # embed_w (1-dim lr weight); slot-dependent lr (optimizer.cuh.h:52-56)
@@ -83,14 +101,8 @@ def sparse_adagrad_apply(ws: Dict[str, jnp.ndarray],
     # lazy mf creation on the *post-accumulation* show/click
     # (optimizer.cuh.h:104-112)
     mf_dim = ws["mf"].shape[1]
-    score = cfg.nonclk_coeff * (show - click) + cfg.clk_coeff * click
-    create = touched & (ws["mf_size"] == 0) & \
-        (score >= cfg.mf_create_thresholds)
-    mf_size = jnp.where(create, mf_dim, ws["mf_size"])
-    # rows train only when already created BEFORE this push (created-now rows
-    # keep their candidate init this step, as the reference returns right
-    # after initialization, optimizer.cuh.h:113-127)
-    mf_touched = touched & (ws["mf_size"] > 0)
+    create, mf_size, mf_touched = _mf_create(ws, cfg, touched, show, click,
+                                             mf_dim)
     mf, mf_g2sum = _adagrad_update(
         ws["mf"], ws["mf_g2sum"], acc["g_embedx"], acc["g_show"],
         cfg.mf_learning_rate, cfg.mf_initial_g2sum, cfg.mf_min_bound,
@@ -113,13 +125,13 @@ def sparse_adagrad_apply(ws: Dict[str, jnp.ndarray],
 
 
 def _shared_adam_group(w, m1, m2, b1p, b2p, g, scale, lr, beta1, beta2,
-                       min_bound, max_bound, touched, n_dim: int):
+                       min_bound, max_bound, touched, n_dim: int,
+                       eps: float = 1e-8):
     """≙ SparseAdamSharedOptimizer::update_value_work
     (optimizer.cuh.h:341-386): ONE shared (moment1, moment2, beta-pow) per
     row for the whole group; per-dim new moments derive from the shared old
     moment, updated w per dim, then the stored moments are the per-dim
     means and the beta powers decay once."""
-    eps = 1e-8
     safe_scale = jnp.where(scale > 0, scale, 1.0)
     ratio = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
     if w.ndim == 2:
@@ -152,34 +164,23 @@ def sparse_adam_apply(ws: Dict[str, jnp.ndarray], acc: Dict[str, jnp.ndarray],
     mf_gsum/mf_g2sum for the embedx group.  Requires the adam state fields
     (feature_value.ADAM_FIELDS — created when config.sgd.optimizer is
     adam/shared_adam)."""
-    n = ws["show"].shape[0]
-    row = jnp.arange(n)
-    touched = (acc["g_show"] > 0) & (row != 0)
-    show = jnp.where(touched, ws["show"] + acc["g_show"], ws["show"])
-    click = jnp.where(touched, ws["click"] + acc["g_click"], ws["click"])
-    delta = jnp.where(
-        touched,
-        ws["delta_score"] + cfg.nonclk_coeff * (acc["g_show"] - acc["g_click"])
-        + cfg.clk_coeff * acc["g_click"],
-        ws["delta_score"])
+    touched, show, click, delta = _common_stats(ws, acc, cfg)
 
     embed_w, e_m1, e_m2, e_b1, e_b2 = _shared_adam_group(
         ws["embed_w"], ws["embed_gsum"], ws["embed_g2sum"],
         ws["embed_b1p"], ws["embed_b2p"], acc["g_embed"], acc["g_show"],
         cfg.learning_rate, cfg.beta1_decay_rate, cfg.beta2_decay_rate,
-        cfg.mf_min_bound, cfg.mf_max_bound, touched, 1)
+        cfg.mf_min_bound, cfg.mf_max_bound, touched, 1, cfg.ada_epsilon)
 
     mf_dim = ws["mf"].shape[1]
-    score = cfg.nonclk_coeff * (show - click) + cfg.clk_coeff * click
-    create = touched & (ws["mf_size"] == 0) & \
-        (score >= cfg.mf_create_thresholds)
-    mf_size = jnp.where(create, mf_dim, ws["mf_size"])
-    mf_touched = touched & (ws["mf_size"] > 0)
+    create, mf_size, mf_touched = _mf_create(ws, cfg, touched, show, click,
+                                             mf_dim)
     mf, m_m1, m_m2, m_b1, m_b2 = _shared_adam_group(
         ws["mf"], ws["mf_gsum"], ws["mf_g2sum"], ws["mf_b1p"], ws["mf_b2p"],
         acc["g_embedx"], acc["g_show"], cfg.mf_learning_rate,
         cfg.beta1_decay_rate, cfg.beta2_decay_rate,
-        cfg.mf_min_bound, cfg.mf_max_bound, mf_touched, mf_dim)
+        cfg.mf_min_bound, cfg.mf_max_bound, mf_touched, mf_dim,
+        cfg.ada_epsilon)
     # rows created this push reset their beta powers to the decay rates
     # (creation init, optimizer.cuh.h:436-441)
     m_b1 = jnp.where(create, cfg.beta1_decay_rate, m_b1)
@@ -202,16 +203,7 @@ def sparse_naive_apply(ws: Dict[str, jnp.ndarray],
                        cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
     """SparseNaiveSGDRule (sparse_sgd_rule.h:77): plain SGD with bound
     clipping, show-scaled grads; g2sum fields unused."""
-    n = ws["show"].shape[0]
-    row = jnp.arange(n)
-    touched = (acc["g_show"] > 0) & (row != 0)
-    show = jnp.where(touched, ws["show"] + acc["g_show"], ws["show"])
-    click = jnp.where(touched, ws["click"] + acc["g_click"], ws["click"])
-    delta = jnp.where(
-        touched,
-        ws["delta_score"] + cfg.nonclk_coeff * (acc["g_show"] - acc["g_click"])
-        + cfg.clk_coeff * acc["g_click"],
-        ws["delta_score"])
+    touched, show, click, delta = _common_stats(ws, acc, cfg)
     safe_scale = jnp.where(acc["g_show"] > 0, acc["g_show"], 1.0)
     embed_w = jnp.where(
         touched,
@@ -219,11 +211,8 @@ def sparse_naive_apply(ws: Dict[str, jnp.ndarray],
                  acc["g_embed"] / safe_scale, cfg.min_bound, cfg.max_bound),
         ws["embed_w"])
     mf_dim = ws["mf"].shape[1]
-    score = cfg.nonclk_coeff * (show - click) + cfg.clk_coeff * click
-    create = touched & (ws["mf_size"] == 0) & \
-        (score >= cfg.mf_create_thresholds)
-    mf_size = jnp.where(create, mf_dim, ws["mf_size"])
-    mf_touched = touched & (ws["mf_size"] > 0)
+    create, mf_size, mf_touched = _mf_create(ws, cfg, touched, show, click,
+                                             mf_dim)
     mf = jnp.where(
         mf_touched[:, None],
         jnp.clip(ws["mf"] + cfg.mf_learning_rate *
@@ -240,10 +229,114 @@ def sparse_naive_apply(ws: Dict[str, jnp.ndarray],
     return out
 
 
+def sparse_std_adagrad_apply(ws: Dict[str, jnp.ndarray],
+                             acc: Dict[str, jnp.ndarray],
+                             cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
+    """StdAdaGradSGDRule (sparse_sgd_rule.h:109, UpdateValueWork in
+    sparse_sgd_rule.cc): adagrad with a *per-dimension* g2sum for the embedx
+    group (field mf_g2sum_d [N, D]) instead of the shared per-row scalar.
+    The 1-dim lr weight is identical to plain adagrad."""
+    touched, show, click, delta = _common_stats(ws, acc, cfg)
+    slot = jnp.where(touched, acc["slot"], ws["slot"])
+    lr_embed = jnp.where(slot == cfg.nodeid_slot, cfg.learning_rate,
+                         cfg.feature_learning_rate)
+    safe_scale = jnp.where(acc["g_show"] > 0, acc["g_show"], 1.0)
+    ratio = lr_embed * jnp.sqrt(cfg.initial_g2sum /
+                                (cfg.initial_g2sum + ws["embed_g2sum"]))
+    sg = acc["g_embed"] / safe_scale
+    embed_w = jnp.where(
+        touched,
+        jnp.clip(ws["embed_w"] + sg * ratio, cfg.min_bound, cfg.max_bound),
+        ws["embed_w"])
+    embed_g2sum = jnp.where(touched, ws["embed_g2sum"] + sg * sg,
+                            ws["embed_g2sum"])
+
+    mf_dim = ws["mf"].shape[1]
+    create, mf_size, mf_touched = _mf_create(ws, cfg, touched, show, click,
+                                             mf_dim)
+    sg_mf = acc["g_embedx"] / safe_scale[:, None]             # [N, D]
+    ratio_d = cfg.mf_learning_rate * jnp.sqrt(
+        cfg.mf_initial_g2sum / (cfg.mf_initial_g2sum + ws["mf_g2sum_d"]))
+    mf = jnp.where(
+        mf_touched[:, None],
+        jnp.clip(ws["mf"] + sg_mf * ratio_d, cfg.mf_min_bound,
+                 cfg.mf_max_bound),
+        ws["mf"])
+    mf_g2sum_d = jnp.where(mf_touched[:, None],
+                           ws["mf_g2sum_d"] + sg_mf * sg_mf,
+                           ws["mf_g2sum_d"])
+
+    out = {"show": show, "click": click, "delta_score": delta, "slot": slot,
+           "embed_w": embed_w, "embed_g2sum": embed_g2sum,
+           "mf_size": mf_size, "mf_g2sum": ws["mf_g2sum"],
+           "mf_g2sum_d": mf_g2sum_d, "mf": mf}
+    for extra in ("mf_ex", "mf_ex_g2sum"):
+        if extra in ws:
+            out[extra] = ws[extra]
+    return out
+
+
+def sparse_adam_dim_apply(ws: Dict[str, jnp.ndarray],
+                          acc: Dict[str, jnp.ndarray],
+                          cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
+    """Per-dimension SparseAdam (CPU SparseAdamSGDRule sparse_sgd_rule.h:126
+    / GPU SparseAdamOptimizer optimizer.cuh.h:148): embedx keeps full [N, D]
+    first/second moments (mf_gsum_d / mf_g2sum_d) with shared scalar
+    beta-power trackers; the 1-dim lr weight uses the scalar moment fields
+    (identical to the shared rule at dim 1)."""
+    eps = cfg.ada_epsilon
+    b1, b2 = cfg.beta1_decay_rate, cfg.beta2_decay_rate
+    touched, show, click, delta = _common_stats(ws, acc, cfg)
+    safe_scale = jnp.where(acc["g_show"] > 0, acc["g_show"], 1.0)
+
+    embed_w, e_m1, e_m2, e_b1, e_b2 = _shared_adam_group(
+        ws["embed_w"], ws["embed_gsum"], ws["embed_g2sum"],
+        ws["embed_b1p"], ws["embed_b2p"], acc["g_embed"], acc["g_show"],
+        cfg.learning_rate, b1, b2, cfg.mf_min_bound, cfg.mf_max_bound,
+        touched, 1, eps)
+
+    mf_dim = ws["mf"].shape[1]
+    create, mf_size, mf_touched = _mf_create(ws, cfg, touched, show, click,
+                                             mf_dim)
+
+    sg = acc["g_embedx"] / safe_scale[:, None]                # [N, D]
+    new_m1 = b1 * ws["mf_gsum_d"] + (1 - b1) * sg
+    new_m2 = b2 * ws["mf_g2sum_d"] + (1 - b2) * sg * sg
+    lr_t = cfg.mf_learning_rate * jnp.sqrt(1.0 - ws["mf_b2p"]) \
+        / (1.0 - ws["mf_b1p"])
+    new_mf = jnp.clip(ws["mf"] + lr_t[:, None]
+                      * (new_m1 / (jnp.sqrt(new_m2) + eps)),
+                      cfg.mf_min_bound, cfg.mf_max_bound)
+    mask = mf_touched[:, None]
+    mf = jnp.where(mask, new_mf, ws["mf"])
+    mf_gsum_d = jnp.where(mask, new_m1, ws["mf_gsum_d"])
+    mf_g2sum_d = jnp.where(mask, new_m2, ws["mf_g2sum_d"])
+    mf_b1p = jnp.where(mf_touched, ws["mf_b1p"] * b1, ws["mf_b1p"])
+    mf_b2p = jnp.where(mf_touched, ws["mf_b2p"] * b2, ws["mf_b2p"])
+    # rows created this push reset their beta powers to the decay rates
+    # (creation init, optimizer.cuh.h:260-268)
+    mf_b1p = jnp.where(create, b1, mf_b1p)
+    mf_b2p = jnp.where(create, b2, mf_b2p)
+
+    out = {"show": show, "click": click, "delta_score": delta,
+           "slot": jnp.where(touched, acc["slot"], ws["slot"]),
+           "embed_w": embed_w, "embed_gsum": e_m1, "embed_g2sum": e_m2,
+           "embed_b1p": e_b1, "embed_b2p": e_b2,
+           "mf_size": mf_size, "mf": mf,
+           "mf_gsum_d": mf_gsum_d, "mf_g2sum_d": mf_g2sum_d,
+           "mf_gsum": ws["mf_gsum"], "mf_g2sum": ws["mf_g2sum"],
+           "mf_b1p": mf_b1p, "mf_b2p": mf_b2p}
+    for extra in ("mf_ex", "mf_ex_g2sum"):
+        if extra in ws:
+            out[extra] = ws[extra]
+    return out
+
+
 OPTIMIZERS = {
     "adagrad": sparse_adagrad_apply,
     "shared_adam": sparse_adam_apply,
-    "adam": sparse_adam_apply,
+    "adam": sparse_adam_dim_apply,
+    "std_adagrad": sparse_std_adagrad_apply,
     "naive": sparse_naive_apply,
 }
 

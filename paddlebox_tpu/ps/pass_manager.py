@@ -53,6 +53,13 @@ class BoxPSEngine:
         self.ws: Optional[Dict[str, jnp.ndarray]] = None
         self.num_keys = 0
 
+        # pass-pipelined preload (≙ PreLoadIntoMemory + pre-build thread,
+        # box_wrapper.h:1141 / ps_gpu_wrapper.cc:907-955): the next pass's
+        # working set builds in the background while the current one trains
+        self._build_thread: Optional[threading.Thread] = None
+        self._next: Optional[tuple] = None     # (mapper, num_keys, ws)
+        self._last_written: Optional[np.ndarray] = None
+
     # -- date / phase --------------------------------------------------------
     def set_date(self, date: str) -> None:
         if self.day_id is not None and date != self.day_id:
@@ -76,10 +83,7 @@ class BoxPSEngine:
             with self._agent_lock:
                 self._agent_keys.append(np.asarray(keys, np.uint64))
 
-    def end_feed_pass(self) -> None:
-        """Dedup pass keys, pull host rows, build the device working set."""
-        assert self._feeding
-        self._feeding = False
+    def _dedup_agent_keys(self) -> np.ndarray:
         with self.timers("dedup_keys"):
             with self._agent_lock:
                 parts = self._agent_keys
@@ -87,21 +91,91 @@ class BoxPSEngine:
             allk = np.concatenate(parts) if parts else \
                 np.empty((0,), np.uint64)
             uniq = np.unique(allk)
-            uniq = uniq[uniq != 0]  # key 0 = reserved zero row
-        self.mapper = embedding.PassKeyMapper(uniq)
-        self.num_keys = len(uniq)
+            return uniq[uniq != 0]  # key 0 = reserved zero row
+
+    def _build_host(self, uniq: np.ndarray) -> tuple:
         with self.timers("build_pull"):
             host_rows = self.table.bulk_pull(uniq)
+        return embedding.PassKeyMapper(uniq), len(uniq), host_rows
+
+    def _upload(self, host_rows) -> Dict[str, jnp.ndarray]:
         with self.timers("build_device"):
             sharding = (self.topology.table_sharding()
                         if self.topology is not None else None)
-            self.ws = embedding.build_working_set(
+            return embedding.build_working_set(
                 host_rows, self.config.embedding_dim, sharding=sharding)
+
+    def _build(self, uniq: np.ndarray) -> tuple:
+        mapper, n, host_rows = self._build_host(uniq)
+        return mapper, n, self._upload(host_rows)
+
+    def end_feed_pass(self, async_build: bool = False) -> None:
+        """Dedup pass keys, pull host rows, build the device working set.
+
+        async_build=True builds in a background thread for the NEXT pass
+        while the current one is still training (≙ EndFeedPass handing the
+        agent to the feedpass thread pool, box_wrapper.cc:152 +
+        start_build_thread ps_gpu_wrapper.cc:907); adopt the result with
+        begin_pass, which also refreshes rows the in-flight pass updates at
+        its end_pass (the reference accepts that staleness — we do not).
+        """
+        assert self._feeding
+        self._feeding = False
+        uniq = self._dedup_agent_keys()
+        if not async_build:
+            assert self._build_thread is None and self._next is None, \
+                "a preloaded pass is pending adoption (begin_pass) — " \
+                "mixing it with a synchronous feed pass would discard data"
+            self.mapper, self.num_keys, self.ws = self._build(uniq)
+            return
+        assert self._build_thread is None, "previous async build not adopted"
+
+        # host-only work in the thread (dedup'd table pull — the slow DRAM/
+        # SSD part); the device upload happens in begin_pass on the MAIN
+        # thread: concurrent device dispatch from two python threads can
+        # deadlock single-stream runtimes
+        def run():
+            self._next = self._build_host(uniq)
+
+        self._build_thread = threading.Thread(target=run, daemon=True)
+        self._build_thread.start()
+
+    def wait_feed_pass_done(self) -> None:
+        """≙ BoxHelper::WaitFeedPassDone (box_wrapper.h:1156)."""
+        if self._build_thread is not None:
+            self._build_thread.join()
+            self._build_thread = None
 
     # -- train pass ----------------------------------------------------------
     def begin_pass(self) -> None:
+        if self._build_thread is not None or self._next is not None:
+            self.wait_feed_pass_done()
+            assert self._next is not None
+            self.mapper, self.num_keys, host_rows = self._next
+            self.ws = self._upload(host_rows)
+            self._next = None
+            self._refresh_stale_rows()
         assert self.ws is not None, "end_feed_pass must run before begin_pass"
         self.pass_id += 1
+
+    def _refresh_stale_rows(self) -> None:
+        """An async-built working set pulled host rows while the previous
+        pass was still training; rows that pass wrote at its end_pass are
+        stale here.  Re-pull the intersection and overwrite."""
+        if self._last_written is None or self.mapper is None \
+                or self.num_keys == 0:
+            return
+        stale = np.intersect1d(self._last_written, self.mapper.sorted_keys,
+                               assume_unique=True)
+        if not len(stale):
+            return
+        with self.timers("refresh_stale"):
+            fresh = self.table.bulk_pull(stale)
+            rows = jnp.asarray(self.mapper(stale))
+            for f in self.ws:
+                if f in fresh:
+                    self.ws[f] = self.ws[f].at[rows].set(
+                        jnp.asarray(fresh[f], self.ws[f].dtype))
 
     def end_pass(self, need_save_delta: bool = False,
                  delta_path: str = "") -> None:
@@ -112,6 +186,7 @@ class BoxPSEngine:
             soa["unseen_days"] = np.zeros((self.num_keys,), np.float32)
             self.table.bulk_write(self.mapper.sorted_keys, soa)
         self.ws = None
+        self._last_written = np.asarray(self.mapper.sorted_keys)
         if need_save_delta and delta_path:
             self.save_delta(delta_path)
 

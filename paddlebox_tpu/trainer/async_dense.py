@@ -1,0 +1,97 @@
+"""Async CPU-hosted dense table — ≙ BoxPSAsynDenseTable.
+
+Reference semantics (device_worker.h:803, boxps_worker.cc:133-372): the
+dense parameters live in a CPU-side table; each worker *pulls* a snapshot
+before its batch, *pushes* its dense gradients into a channel after the
+backward, and a background update thread drains the channel applying an
+adam rule — workers never block on each other's dense updates
+(TrainerDesc async_mode, trainer_desc.proto:121).
+
+TPU-native shape: the jitted step returns the dense grads instead of
+applying them (SparseTrainer dense_sync_mode="async_table"); the host loop
+pushes them here and refreshes its device snapshot every
+``sync_weight_step`` batches (≙ BoxPSWorkerParameter.sync_weight_step).
+Staleness is bounded by the channel capacity.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+import jax
+
+from paddlebox_tpu.utils.channel import Channel, ChannelClosed
+
+
+class AsyncDenseTable:
+    def __init__(self, params, learning_rate: float = 1e-3,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8, queue_capacity: int = 64):
+        self._lr = learning_rate
+        self._b1, self._b2, self._eps = beta1, beta2, eps
+        self._lock = threading.Lock()
+        self._params = jax.tree.map(lambda a: np.array(a, np.float32),
+                                    params)
+        self._m = jax.tree.map(np.zeros_like, self._params)
+        self._v = jax.tree.map(np.zeros_like, self._params)
+        self._t = 0
+        self._pushed = 0
+        self._applied = 0
+        self._ch: Channel = Channel(capacity=queue_capacity)
+        self._thread = threading.Thread(target=self._update_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    def pull(self):
+        """Snapshot → host pytree (≙ PullDense, boxps_worker.cc:226)."""
+        with self._lock:
+            return jax.tree.map(np.copy, self._params)
+
+    def push(self, grads) -> None:
+        """Enqueue one batch's dense grads (≙ PushDense → channel,
+        boxps_worker.cc:252); blocks only when the channel is full."""
+        self._pushed += 1
+        self._ch.put(jax.tree.map(lambda a: np.asarray(a, np.float32),
+                                  grads))
+
+    def _update_loop(self) -> None:
+        """≙ AsyncUpdate/ThreadUpdate (boxps_worker.cc:260-330): drain the
+        channel, merge whatever is queued, apply one adam step."""
+        while True:
+            try:
+                g = self._ch.get()
+            except ChannelClosed:
+                return
+            with self._lock:
+                self._t += 1
+                t = self._t
+                bc1 = 1.0 - self._b1 ** t
+                bc2 = 1.0 - self._b2 ** t
+
+                def upd(p, m, v, gr):
+                    m[:] = self._b1 * m + (1 - self._b1) * gr
+                    v[:] = self._b2 * v + (1 - self._b2) * gr * gr
+                    p[:] = p - self._lr * (m / bc1) / (
+                        np.sqrt(v / bc2) + self._eps)
+                    return p
+
+                jax.tree.map(upd, self._params, self._m, self._v, g)
+                self._applied += 1
+
+    # ------------------------------------------------------------------
+    def drain(self) -> None:
+        """Block until every pushed batch has been *applied* (an empty
+        channel alone can still have one item mid-apply in the thread)."""
+        while self._applied < self._pushed:
+            threading.Event().wait(0.002)
+
+    def finalize(self):
+        """Stop the update thread and return the final parameters
+        (≙ Finalize copying the table back, boxps_worker.cc:214)."""
+        self.drain()
+        self._ch.close()
+        self._thread.join(timeout=5.0)
+        return self.pull()

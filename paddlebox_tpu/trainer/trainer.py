@@ -66,6 +66,22 @@ class SparseTrainer:
         self.dense_tx = dense_optimizer or optax.adam(1e-3)
         self.params = model.init(jax.random.PRNGKey(seed))
         self.opt_state = self.dense_tx.init(self.params)
+        # ≙ BoxPSAsynDenseTable (dense_sync_mode="async_table"): dense
+        # params live in a CPU table updated by a background thread; the
+        # jitted step only *computes* dense grads
+        self.async_dense = None
+        if self.trainer_config.dense_sync_mode == "async_table":
+            if dense_optimizer is not None:
+                raise ValueError(
+                    "dense_sync_mode='async_table' uses the table's own "
+                    "adam rule (TrainerConfig.async_dense_*); an explicit "
+                    "dense_optimizer would be silently ignored")
+            from paddlebox_tpu.trainer.async_dense import AsyncDenseTable
+            tc = self.trainer_config
+            self.async_dense = AsyncDenseTable(
+                self.params, learning_rate=tc.async_dense_learning_rate,
+                beta1=tc.async_dense_beta1, beta2=tc.async_dense_beta2,
+                eps=tc.async_dense_eps)
         self.auc_table_size = auc_table_size
         self.auc_state = make_auc_state(auc_table_size)
         self.auc = AucCalculator(auc_table_size)
@@ -116,6 +132,10 @@ class SparseTrainer:
             return self._build_step_fast()
         if path != "reference":
             raise ValueError(f"unknown sparse_path {path!r}")
+        if self.async_dense is not None:
+            raise ValueError(
+                "dense_sync_mode='async_table' requires the mxu or fast "
+                "sparse path")
         return self._build_step_reference()
 
     def _pooled_dense_half(self):
@@ -126,6 +146,8 @@ class SparseTrainer:
         model = self.model
         dense_tx = self.dense_tx
         amp = self.amp
+
+        apply_dense = self.async_dense is None
 
         def half(params, opt_state, auc_state, pooled, dense, labels, valid):
             B = pooled.shape[0]
@@ -147,10 +169,13 @@ class SparseTrainer:
 
             (loss, preds), (d_params, d_pooled) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1), has_aux=True)(params, pooled)
-            updates, opt_state = dense_tx.update(d_params, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            if apply_dense:
+                updates, opt_state = dense_tx.update(d_params, opt_state,
+                                                     params)
+                params = optax.apply_updates(params, updates)
             auc_state = accumulate_auc(auc_state, preds, labels, valid)
-            return params, opt_state, auc_state, loss, preds, d_pooled
+            return (params, opt_state, auc_state, loss, preds, d_pooled,
+                    d_params)
 
         return half
 
@@ -180,14 +205,17 @@ class SparseTrainer:
             plan = mxu_path.build_plan(idx, dims)
             pooled = jax.lax.stop_gradient(mxu_path.pull_pool_cvm(
                 ws, plan, dims, (s, l, b), use_cvm, interpret=interpret))
-            params, opt_state, auc_state, loss, preds, d_pooled = half(
+            (params, opt_state, auc_state, loss, preds, d_pooled,
+             d_params) = half(
                 params, opt_state, auc_state, pooled, dense, labels, valid)
             ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
             ws = mxu_path.push_and_update(ws, plan, dims, idx, d_pooled,
                                           ins_cvm, slot_ids, sgd_cfg,
                                           interpret=interpret)
-            return ws, params, opt_state, auc_state, loss, preds
+            out = (ws, params, opt_state, auc_state, loss, preds)
+            return out + ((d_params,) if async_dense else ())
 
+        async_dense = self.async_dense is not None
         self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     def _build_step_fast(self):
@@ -204,13 +232,16 @@ class SparseTrainer:
             idx = jnp.transpose(indices, (0, 2, 1))        # [S, L, B]
             pooled = jax.lax.stop_gradient(
                 fast_path.pull_pool_cvm(ws, idx, lengths, use_cvm))
-            params, opt_state, auc_state, loss, preds, d_pooled = half(
+            (params, opt_state, auc_state, loss, preds, d_pooled,
+             d_params) = half(
                 params, opt_state, auc_state, pooled, dense, labels, valid)
             ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
             ws = fast_path.push_and_update(ws, idx, lengths, d_pooled,
                                            ins_cvm, slot_ids, sgd_cfg)
-            return ws, params, opt_state, auc_state, loss, preds
+            out = (ws, params, opt_state, auc_state, loss, preds)
+            return out + ((d_params,) if async_dense else ())
 
+        async_dense = self.async_dense is not None
         self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     def _build_step_reference(self):
@@ -329,8 +360,17 @@ class SparseTrainer:
                 break
             dev = self._put_batch(batch)
             with self.timers("step"):
-                ws, params, opt_state, auc_state, loss, preds = \
-                    self._step_fn(ws, params, opt_state, auc_state, *dev)
+                out = self._step_fn(ws, params, opt_state, auc_state, *dev)
+            if self.async_dense is not None:
+                ws, params, opt_state, auc_state, loss, preds, d_params = out
+                # ≙ PushDense (boxps_worker.cc:252): grads to the CPU table
+                self.async_dense.push(d_params)
+                if (n_batches + 1) % max(
+                        self.trainer_config.sync_weight_step, 1) == 0:
+                    # ≙ PullDense snapshot refresh (boxps_worker.cc:1301)
+                    params = jax.device_put(self.async_dense.pull())
+            else:
+                ws, params, opt_state, auc_state, loss, preds = out
             if self._check_nan and not np.isfinite(float(loss)):
                 raise FloatingPointError(
                     f"NaN/Inf loss at batch {n_batches}")
@@ -345,6 +385,9 @@ class SparseTrainer:
         t.join()
         if dump_file is not None:
             dump_file.close()
+        if self.async_dense is not None:
+            self.async_dense.drain()
+            params = jax.device_put(self.async_dense.pull())
         engine.ws = ws
         self.params = params
         self.opt_state = opt_state

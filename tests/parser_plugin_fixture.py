@@ -1,0 +1,22 @@
+"""Fixture plugin for ParserPluginManager (≙ a site-specific CustomParser
+.so — here an importable python factory)."""
+
+import numpy as np
+
+from paddlebox_tpu.data.slot_record import SlotRecordBlock
+
+
+class _OneRecordParser:
+    def __init__(self, config):
+        self.config = config
+
+    def parse_block(self, lines):
+        name = self.config.slots[0].name
+        return SlotRecordBlock(
+            n=1,
+            uint64_slots={name: (np.array([5], np.uint64),
+                                 np.array([0, 1], np.int64))})
+
+
+def create_parser(config):
+    return _OneRecordParser(config)

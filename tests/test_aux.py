@@ -84,3 +84,59 @@ def test_profiler_trace(tmp_path):
     assert any(os.scandir(str(tmp_path / "trace")))
     with annotate("outside"):
         pass
+
+
+# ---------------------------------------------------------------------------
+# geo-async sparse table + parser plugin manager
+# ---------------------------------------------------------------------------
+
+def test_geo_sparse_table_protocol():
+    import numpy as np
+    from paddlebox_tpu.ps.geo_table import GeoSparseTable
+    t = GeoSparseTable(dim=3, num_trainers=2, learning_rate=0.5)
+    keys = np.array([7, 9], np.uint64)
+    t.push_sparse_param(keys, np.ones((2, 3), np.float32))
+    # trainer 0 pushes an update on key 7
+    t.push_sparse(np.array([7], np.uint64),
+                  np.array([[2.0, 0.0, 0.0]], np.float32))
+    np.testing.assert_allclose(t.pull_sparse(np.array([7], np.uint64))[0],
+                               [0.0, 1.0, 1.0])
+    # both trainers see key 7 pending; pulls clear independently
+    ids0, vals0 = t.pull_geo_param(0)
+    assert ids0.tolist() == [7]
+    np.testing.assert_allclose(vals0[0], [0.0, 1.0, 1.0])
+    ids0b, _ = t.pull_geo_param(0)
+    assert ids0b.size == 0
+    ids1, _ = t.pull_geo_param(1)
+    assert ids1.tolist() == [7]
+    # unknown keys pull zeros
+    assert t.pull_sparse(np.array([42], np.uint64))[0].tolist() == [0, 0, 0]
+
+
+def test_parser_plugin_manager_python_factory():
+    import numpy as np
+    from paddlebox_tpu.config import DataFeedConfig, SlotConfig
+    from paddlebox_tpu.data.data_feed import load_parser_plugin
+    cfg = DataFeedConfig(slots=(SlotConfig("s0", slot_id=1),))
+    parser = load_parser_plugin(
+        "tests.parser_plugin_fixture:create_parser", cfg)
+    block = parser.parse_block(["ignored line"])
+    assert block.n == 1
+
+
+def test_parser_plugin_so_override_symbol_used(tmp_path):
+    """.so plugin path must call the plugin's symbol, not the built-in."""
+    from paddlebox_tpu.config import DataFeedConfig, SlotConfig
+    from paddlebox_tpu.data.data_feed import ParserPluginManager
+    from paddlebox_tpu.native import build
+    if not build.ensure_built():
+        import pytest
+        pytest.skip("native lib not built")
+    cfg = DataFeedConfig(slots=(SlotConfig("s0", slot_id=1),))
+    # the built-in lib itself acts as the "plugin" .so — exercises dlopen +
+    # symbol dispatch through the override attributes
+    mgr = ParserPluginManager()
+    parser = mgr.load(f"{build.lib_path()}:pbox_parse_block", cfg)
+    assert parser._entry == "pbox_parse_block" and parser._lib is not None
+    block = parser.parse_block(["1 5"])
+    assert block.n == 1

@@ -130,3 +130,58 @@ def test_save_load_resume(data_file, tmp_path):
     b = engine2.table.bulk_pull(k)
     for f in ("show", "embed_w", "mf"):
         np.testing.assert_allclose(a[f], b[f])
+
+
+def test_async_dense_table_training():
+    """dense_sync_mode=async_table: grads flow through the CPU table's
+    background adam thread (≙ BoxPSAsynDenseTable, boxps_worker.cc:133)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddlebox_tpu.config import (DataFeedConfig, EmbeddingTableConfig,
+                                      SlotConfig, SparseSGDConfig,
+                                      TrainerConfig)
+    from paddlebox_tpu.data.batch_pack import PackedBatch
+    from paddlebox_tpu.models.ctr_dnn import CtrDnn
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+    from paddlebox_tpu.trainer.trainer import SparseTrainer
+
+    S, MF, DD, B, L = 3, 4, 2, 16, 2
+    slots = [SlotConfig("label", dtype="float", is_dense=True, dim=1),
+             SlotConfig("d0", dtype="float", is_dense=True, dim=DD)]
+    slots += [SlotConfig(f"s{i}", slot_id=10 + i, capacity=L)
+              for i in range(S)]
+    cfg = DataFeedConfig(slots=tuple(slots))
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=MF, shard_num=2,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    eng.begin_feed_pass()
+    eng.add_keys(np.arange(1, 100, dtype=np.uint64))
+    eng.end_feed_pass()
+    eng.begin_pass()
+    model = CtrDnn(num_slots=S, emb_width=3 + MF, dense_dim=DD, hidden=(8,))
+    tr = SparseTrainer(
+        eng, model, cfg, batch_size=B, auc_table_size=100,
+        trainer_config=TrainerConfig(dense_sync_mode="async_table",
+                                     sync_weight_step=2))
+    tr._build_step()
+    p0 = jax.tree.map(np.array, tr.async_dense.pull())
+    rng = np.random.default_rng(0)
+    ws, params = eng.ws, tr.params
+    opt, auc = tr.opt_state, tr.auc_state
+    for i in range(4):
+        batch = PackedBatch(
+            indices=rng.integers(1, 100, (S, B, L)).astype(np.int32),
+            lengths=np.full((S, B), L, np.int32),
+            dense=rng.normal(0, 1, (B, DD)).astype(np.float32),
+            labels=rng.integers(0, 2, (B,)).astype(np.float32),
+            valid=np.ones((B,), bool), num_real=B)
+        dev = tr._put_batch(batch)
+        ws, params, opt, auc, loss, preds, d_params = tr._step_fn(
+            ws, params, opt, auc, *dev)
+        tr.async_dense.push(d_params)
+        assert np.isfinite(float(loss))
+    final = tr.async_dense.finalize()
+    moved = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(np.abs(a - b).max()), p0, final))
+    assert max(moved) > 0, "async table never applied any update"

@@ -89,3 +89,36 @@ def test_mmoe_shapes():
     dense = np.random.randn(8, 2).astype(np.float32)
     out = model.apply_multi(params, pooled, dense)
     assert out.shape == (8, 2)
+
+
+def test_pipelined_pass_preload_refreshes_stale_rows():
+    """Async next-pass build overlapping training must see the previous
+    pass's end_pass write-back (staleness refresh in begin_pass)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=4, shard_num=2,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    # pass 1: keys 1..10
+    eng.begin_feed_pass()
+    eng.add_keys(np.arange(1, 11, dtype=np.uint64))
+    eng.end_feed_pass()
+    eng.begin_pass()
+    # while pass 1 "trains", preload pass 2 (overlapping keys 5..15)
+    eng.begin_feed_pass()
+    eng.add_keys(np.arange(5, 16, dtype=np.uint64))
+    eng.end_feed_pass(async_build=True)
+    eng.wait_feed_pass_done()
+    # pass 1 training mutates key 5's embed_w, then writes back
+    row5 = int(eng.mapper(np.array([5], np.uint64))[0])
+    eng.ws["embed_w"] = eng.ws["embed_w"].at[row5].set(3.25)
+    eng.end_pass()
+    # pass 2 adoption must pick up the fresh value despite having pulled
+    # its host rows before pass 1's write-back
+    eng.begin_pass()
+    row5b = int(eng.mapper(np.array([5], np.uint64))[0])
+    assert float(eng.ws["embed_w"][row5b]) == 3.25
+    eng.end_pass()

@@ -118,3 +118,114 @@ def test_alias_method():
                            jnp.asarray(alias), (200_000,))
     freq = np.bincount(np.asarray(samples), minlength=4) / 200_000
     np.testing.assert_allclose(freq, probs, atol=0.01)
+
+
+# ---------------------------------------------------------------------------
+# per-dim rules: std_adagrad (sparse_sgd_rule.h:109) and adam (:126)
+# ---------------------------------------------------------------------------
+
+def _base_ws(n=4, d=3, optimizer=""):
+    import numpy as np
+    import jax.numpy as jnp
+    from paddlebox_tpu.ps import feature_value as fv
+    rng = np.random.default_rng(0)
+    soa = fv.default_rows(n, d, rng, 1e-2, optimizer=optimizer)
+    soa["show"][:] = [0, 3, 5, 2]
+    soa["mf_size"][:] = [0, d, d, 0]
+    ws = {k: jnp.asarray(v) for k, v in soa.items()}
+    # emulate build_working_set's reserved row by making row 0 the pad row
+    return ws
+
+
+def _acc(n=4, d=3):
+    import numpy as np
+    import jax.numpy as jnp
+    rng = np.random.default_rng(1)
+    return {
+        "g_show": jnp.asarray([0.0, 2.0, 1.0, 3.0], jnp.float32),
+        "g_click": jnp.asarray([0.0, 1.0, 0.0, 1.0], jnp.float32),
+        "g_embed": jnp.asarray(rng.normal(0, 1, (n,)), jnp.float32),
+        "g_embedx": jnp.asarray(rng.normal(0, 1, (n, d)), jnp.float32),
+        "slot": jnp.asarray([0, 7, 7, 7], jnp.int32),
+    }
+
+
+def test_std_adagrad_per_dim_g2sum():
+    import numpy as np
+    from paddlebox_tpu.config import SparseSGDConfig
+    from paddlebox_tpu.ps import optimizer
+    cfg = SparseSGDConfig(optimizer="std_adagrad", mf_create_thresholds=1e9)
+    ws, acc = _base_ws(optimizer="std_adagrad"), _acc()
+    out = optimizer.apply_push(ws, acc, cfg)
+    # scalar reference for the touched, mf-created row 2
+    i, d = 2, 3
+    scale = float(acc["g_show"][i])
+    for j in range(d):
+        sg = float(acc["g_embedx"][i, j]) / scale
+        ratio = cfg.mf_learning_rate * np.sqrt(
+            cfg.mf_initial_g2sum /
+            (cfg.mf_initial_g2sum + float(ws["mf_g2sum_d"][i, j])))
+        want = np.clip(float(ws["mf"][i, j]) + sg * ratio,
+                       cfg.mf_min_bound, cfg.mf_max_bound)
+        np.testing.assert_allclose(float(out["mf"][i, j]), want, rtol=1e-5)
+        np.testing.assert_allclose(float(out["mf_g2sum_d"][i, j]),
+                                   float(ws["mf_g2sum_d"][i, j]) + sg * sg,
+                                   rtol=1e-5)
+    # untouched row 0 unchanged
+    np.testing.assert_array_equal(np.asarray(out["mf"][0]),
+                                  np.asarray(ws["mf"][0]))
+
+
+def test_adam_per_dim_moments():
+    import numpy as np
+    from paddlebox_tpu.config import SparseSGDConfig
+    from paddlebox_tpu.ps import optimizer
+    cfg = SparseSGDConfig(optimizer="adam", mf_create_thresholds=1e9)
+    ws, acc = _base_ws(optimizer="adam"), _acc()
+    out = optimizer.apply_push(ws, acc, cfg)
+    i, d = 1, 3
+    b1, b2, eps = cfg.beta1_decay_rate, cfg.beta2_decay_rate, cfg.ada_epsilon
+    scale = float(acc["g_show"][i])
+    b1p, b2p = float(ws["mf_b1p"][i]), float(ws["mf_b2p"][i])
+    lr_t = cfg.mf_learning_rate * np.sqrt(1 - b2p) / (1 - b1p)
+    for j in range(d):
+        sg = float(acc["g_embedx"][i, j]) / scale
+        m1 = b1 * float(ws["mf_gsum_d"][i, j]) + (1 - b1) * sg
+        m2 = b2 * float(ws["mf_g2sum_d"][i, j]) + (1 - b2) * sg * sg
+        want = np.clip(float(ws["mf"][i, j]) + lr_t * m1 / (np.sqrt(m2) + eps),
+                       cfg.mf_min_bound, cfg.mf_max_bound)
+        np.testing.assert_allclose(float(out["mf"][i, j]), want, rtol=1e-5)
+        np.testing.assert_allclose(float(out["mf_gsum_d"][i, j]), m1,
+                                   rtol=1e-5)
+    # beta powers decay once per touched row
+    np.testing.assert_allclose(float(out["mf_b1p"][i]), b1p * b1, rtol=1e-6)
+    # per-dim moments MUST differ across dims for unequal grads (the shared
+    # rule would collapse them to one scalar)
+    m = np.asarray(out["mf_gsum_d"][i])
+    assert len(np.unique(np.round(m, 8))) > 1
+
+
+def test_mxu_path_with_adam_and_std_rules():
+    """new rules compose with the mxu accumulators end-to-end."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddlebox_tpu.config import SparseSGDConfig
+    from paddlebox_tpu.ps import embedding, feature_value as fv, mxu_path
+    for opt in ("adam", "std_adagrad"):
+        cfg = SparseSGDConfig(optimizer=opt, mf_create_thresholds=0.0)
+        rng = np.random.default_rng(2)
+        n, D, S, L, B = 100, 4, 3, 2, 8
+        host = fv.default_rows(n - 1, D, rng, 1e-2, optimizer=opt)
+        host["mf_size"][:] = D
+        host["show"][:] = 1.0
+        ws = embedding.build_working_set(host, D, pad_to=n)
+        idx = jnp.asarray(rng.integers(1, n, (S, L, B)), jnp.int32)
+        d_pooled = jnp.asarray(rng.normal(0, 1, (B, S, 3 + D)), jnp.float32)
+        ins = jnp.asarray(np.stack([np.ones(B), np.zeros(B)], 1), jnp.float32)
+        slots = jnp.arange(S, dtype=jnp.int32)
+        dims = mxu_path.make_dims(S * L * B, n)
+        plan = mxu_path.build_plan(idx, dims)
+        out = mxu_path.push_and_update(ws, plan, dims, idx, d_pooled, ins,
+                                       slots, cfg, interpret=True)
+        assert np.isfinite(np.asarray(out["mf"])).all()
+        assert not np.allclose(np.asarray(out["mf"]), np.asarray(ws["mf"]))

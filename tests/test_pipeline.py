@@ -84,3 +84,59 @@ def test_segment_layers():
     assert segment_layers(10, 4) == [3, 3, 2, 2]
     assert segment_layers(8, 4) == [2, 2, 2, 2]
     assert segment_layers(3, 4) == [1, 1, 1, 0]
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule
+# ---------------------------------------------------------------------------
+
+def test_1f1b_matches_sequential_grads():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddlebox_tpu.parallel.pipeline import (PipelineRunner1F1B,
+                                                 stack_stage_params)
+
+    pp, M, Bm, D = 4, 6, 8, 16
+    devs = jax.devices()[:pp]
+    mesh = Mesh(np.array(devs), ("pp",))
+    rng = np.random.default_rng(0)
+    stage_params = [
+        {"w": jnp.asarray(rng.normal(0, 0.3, (D, D)).astype(np.float32)),
+         "b": jnp.asarray(rng.normal(0, 0.1, (D,)).astype(np.float32))}
+        for _ in range(pp)]
+    stacked = stack_stage_params(stage_params)
+    mbs = jnp.asarray(rng.normal(0, 1, (M, Bm, D)).astype(np.float32))
+    tgt = jnp.asarray(rng.normal(0, 1, (M, Bm, D)).astype(np.float32))
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    runner = PipelineRunner1F1B(stage_fn, loss_fn, pp)
+    run = jax.jit(jax.shard_map(
+        runner, mesh=mesh,
+        in_specs=(P("pp"), P(), P()),
+        out_specs=(P(), P("pp")), check_vma=False))
+    loss, grads = run(stacked, mbs, tgt)
+
+    # sequential reference: same loss/grads without any pipeline
+    def seq_loss(stages):
+        total = 0.0
+        for m in range(M):
+            x = mbs[m]
+            for sp_ in stages:
+                x = stage_fn(sp_, x)
+            total = total + loss_fn(x, tgt[m])
+        return total / M
+
+    ref_loss, ref_grads = jax.value_and_grad(seq_loss)(stage_params)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for i in range(pp):
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(grads[k][i]), np.asarray(ref_grads[i][k]),
+                atol=1e-5, rtol=1e-4, err_msg=f"stage {i} {k}")
