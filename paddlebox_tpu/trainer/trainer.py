@@ -17,6 +17,7 @@ boxps_worker.cc:1191) is implicit in GSPMD — no hand-written collective.
 from __future__ import annotations
 
 import threading
+import time
 from functools import partial
 from typing import Dict, Iterator, Optional
 
@@ -314,12 +315,15 @@ class SparseTrainer:
             out.append(jax.device_put(a, sh))
         return tuple(out)
 
-    def train_pass(self, dataset: SlotDataset, prefetch: int = 4
-                   ) -> Dict[str, float]:
+    def train_pass(self, dataset: SlotDataset, prefetch: int = 4,
+                   pack_threads: int = 1) -> Dict[str, float]:
         """Run one full pass over the dataset (≙ TrainFiles loop).
 
-        Packing runs in a background thread feeding a bounded channel so the
-        device step overlaps with host batch assembly.
+        Packing runs in background threads feeding a bounded channel so the
+        device step overlaps with host batch assembly.  pack_threads > 1
+        fans batch assembly over a thread pool (numpy releases the GIL)
+        while the bounded channel of ordered futures preserves batch order
+        (≙ the per-device PackBatchTask threads, boxps_worker.cc:1259).
         """
         if self._step_fn is None:
             self._build_step()
@@ -328,12 +332,20 @@ class SparseTrainer:
         mapper = engine.mapper
         ch = Channel(capacity=prefetch)
 
+        import concurrent.futures
+        pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, pack_threads))
+
+        def pack_one(block):
+            t0 = time.perf_counter()
+            b = self.packer.pack(block, key_mapper=mapper)
+            self.timers.add("pack", time.perf_counter() - t0)
+            return b
+
         def packer_thread():
             try:
                 for block in dataset.batches(self.batch_size):
-                    with self.timers("pack"):
-                        b = self.packer.pack(block, key_mapper=mapper)
-                    ch.put(b)
+                    ch.put(pool.submit(pack_one, block))
             finally:
                 ch.close()
 
@@ -355,7 +367,7 @@ class SparseTrainer:
                 f"{self.engine.pass_id}.txt", "w")
         while True:
             try:
-                batch = ch.get()
+                batch = ch.get().result()
             except ChannelClosed:
                 break
             dev = self._put_batch(batch)
@@ -383,6 +395,7 @@ class SparseTrainer:
             losses.append(loss)
             n_batches += 1
         t.join()
+        pool.shutdown(wait=True)
         if dump_file is not None:
             dump_file.close()
         if self.async_dense is not None:

@@ -58,6 +58,16 @@ class TimerRegistry:
                 self._timers[name] = Timer()
             return self._timers[name]
 
+    def add(self, name: str, seconds: float) -> None:
+        """Thread-safe accumulate for timers shared by worker pools (a bare
+        ``with registry(name)`` races when two threads time the same name)."""
+        with self._lock:
+            if name not in self._timers:
+                self._timers[name] = Timer()
+            t = self._timers[name]
+            t._elapsed += seconds
+            t._count += 1
+
     def report(self) -> str:
         with self._lock:
             parts = [f"{k}={t.elapsed_sec():.3f}s/{t.count()}"
