@@ -1,58 +1,124 @@
 """Benchmark: Criteo-shaped sparse-CTR training throughput on one chip.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "examples/s", "vs_baseline": N, ...}
-vs_baseline is against the north-star 1M examples/sec/chip (BASELINE.md).
-The headline value is END-TO-END examples/s — the full train_pass loop
-(host batch packing + key translation + H2D + jitted train step, the loop
-≙ BoxPSWorker::TrainFiles boxps_worker.cc:1278), streaming fresh batches
-through the packer thread pool + bounded channel.  `device_step` (steady
-re-fed device step, the round-1 quantity) is reported alongside.
+Prints JSON lines on stdout; the LAST line is the result the driver
+records.  The headline value is END-TO-END examples/s — the full
+train_pass loop (host batch packing + key translation + H2D + jitted
+train step, the loop ≙ BoxPSWorker::TrainFiles boxps_worker.cc:1278).
+`device_step` (steady re-fed device step) is reported alongside;
+`basis` names which quantity the headline value is.
 
-Geometry: 26 sparse slots with variable lengths 1..3 (capacity 3), 13
-dense features, mf_dim=8, 2M-key working set, B=16384.
+Diagnosable by construction (≙ the per-phase timer discipline of
+TrainFilesWithProfiler, boxps_worker.cc:1358):
+ * every phase prints a timestamped checkpoint to STDERR, so a captured
+   tail locates any hang exactly;
+ * a SMOKE geometry (B=1024, 2 batches, 100k keys) runs the whole path
+   first and emits its own JSON line before the full config is attempted;
+ * partial numbers (smoke/device_step/e2e) are recorded the moment they
+   are measured; the watchdog emits the best value seen so far plus the
+   name of the wedged phase — never a bare 0.0;
+ * each phase has its own budget; a wedged phase fails fast.
 
-Hardened per VERDICT.md: backend init retries, a watchdog that emits a
-parseable JSON error line instead of hanging the chip, and JSON error
-output on any failure (exit code 0 so the driver can always parse).
+Geometry (full): 26 sparse slots with variable lengths 1..3 (capacity 3),
+13 dense features, mf_dim=8, 2M-key working set, B=16384.
 
 Env knobs: BENCH_BATCH_SIZE, BENCH_BATCHES, BENCH_KEYS, BENCH_TIMEOUT_S,
-BENCH_PACK_THREADS.
+BENCH_PACK_THREADS, BENCH_SKIP_SMOKE=1, BENCH_SMOKE_ONLY=1.
 """
 
 import json
+import math
 import os
 import sys
+import threading
 import time
 
 import numpy as np
 
 METRIC = "criteo_deepfm_train_examples_per_sec_per_chip"
+T0 = time.time()
+TOTAL_BUDGET = int(os.environ.get("BENCH_TIMEOUT_S", 1500))
+_LOCK = threading.Lock()
+_STATE = {
+    "phase": "start",
+    "deadline": T0 + TOTAL_BUDGET,
+    "partial": {},     # numbers recorded as soon as they are measured
+    "done": False,
+}
 
 
-def _emit(value: float, **extra) -> None:
+def trace(msg: str) -> None:
+    print(f"[bench +{time.time() - T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def set_phase(name: str, budget_s: float) -> None:
+    """Enter a phase: stderr checkpoint + its own watchdog budget (capped
+    by the global deadline, minus a grace window to emit before the driver
+    kills us)."""
+    hard = T0 + TOTAL_BUDGET - 20
+    with _LOCK:
+        _STATE["phase"] = name
+        _STATE["deadline"] = min(time.time() + budget_s, hard)
+    trace(f"phase={name} budget={budget_s:.0f}s")
+
+
+def record(**kw) -> None:
+    with _LOCK:
+        _STATE["partial"].update(kw)
+
+
+def _best() -> float:
+    p = _STATE["partial"]
+    for k in ("e2e", "device_step", "smoke_e2e", "smoke_device_step"):
+        v = p.get(k)
+        if v:
+            return float(v)
+    return 0.0
+
+
+def _san(o):
+    """json-strict: non-finite floats become null (driver must always be
+    able to parse the line)."""
+    if isinstance(o, float) and not math.isfinite(o):
+        return None
+    if isinstance(o, dict):
+        return {k: _san(v) for k, v in o.items()}
+    if isinstance(o, (list, tuple)):
+        return [_san(v) for v in o]
+    return o
+
+
+def emit(value: float, final: bool = False, **extra) -> None:
+    if final:
+        # retire the watchdog BEFORE printing, or it can race a late
+        # phase-budget expiry and append an error line after the result
+        with _LOCK:
+            _STATE["done"] = True
     line = {"metric": METRIC, "value": round(float(value), 1),
             "unit": "examples/s",
             "vs_baseline": round(float(value) / 1_000_000.0, 4)}
     line.update(extra)
-    print(json.dumps(line))
-    sys.stdout.flush()
+    print(json.dumps(_san(line)), flush=True)
 
 
-def _arm_watchdog(seconds: int) -> None:
-    """Never leave the driver with a silent hang holding the chip: on
-    timeout, print the JSON error line and hard-exit."""
-    import signal
-
-    def fire(signum, frame):
-        _emit(0.0, error=f"bench watchdog fired after {seconds}s")
-        os._exit(0)
-
-    try:
-        signal.signal(signal.SIGALRM, fire)
-        signal.alarm(seconds)
-    except (ValueError, AttributeError):
-        pass  # non-main thread / platform without SIGALRM
+def _watchdog() -> None:
+    """Thread watchdog (survives the main thread being wedged inside an
+    XLA compile, where SIGALRM handlers never run): on phase-budget expiry
+    emit the best partial value + the wedged phase name, then hard-exit."""
+    while True:
+        time.sleep(2)
+        with _LOCK:
+            if _STATE["done"]:
+                return
+            expired = time.time() > _STATE["deadline"]
+            phase = _STATE["phase"]
+            partial = dict(_STATE["partial"])
+        if expired:
+            emit(_best(),
+                 error=f"watchdog: phase '{phase}' exceeded its budget",
+                 last_phase=phase, partial=partial,
+                 elapsed_s=round(time.time() - T0, 1))
+            os._exit(0)
 
 
 def _init_devices(retries: int = 3, delay: float = 5.0):
@@ -63,6 +129,7 @@ def _init_devices(retries: int = 3, delay: float = 5.0):
             return jax.devices()
         except Exception as e:  # backend init is flaky under the tunnel
             last = e
+            trace(f"backend init attempt {attempt + 1} failed: {e!r}")
             if attempt + 1 < retries:
                 time.sleep(delay)
     raise RuntimeError(
@@ -96,7 +163,9 @@ def _make_blocks(rng, n_records, sparse_names, n_keys, dense_dim, cap,
     return blocks
 
 
-def run() -> None:
+def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
+    """One full bench at a given geometry.  Returns the results dict;
+    records partials into _STATE as they are measured."""
     import jax
     import jax.numpy as jnp
 
@@ -108,30 +177,20 @@ def run() -> None:
     from paddlebox_tpu.trainer.trainer import SparseTrainer
 
     N_SLOTS, DENSE_DIM, MF_DIM, CAP = 26, 13, 8, 3
-    B = int(os.environ.get("BENCH_BATCH_SIZE", 16384))
-    N_BATCHES = int(os.environ.get("BENCH_BATCHES", 30))
-    N_KEYS = int(os.environ.get("BENCH_KEYS", 2_000_000))
-    PACK_THREADS = int(os.environ.get(
-        "BENCH_PACK_THREADS", min(8, os.cpu_count() or 1)))
     STEPS_WARM = 5
 
-    devices = _init_devices()
-    backend = devices[0].platform
-
-    sparse_names = [f"s{i}" for i in range(N_SLOTS)]
-    slots = [SlotConfig("label", dtype="float", is_dense=True, dim=1),
-             SlotConfig("dense0", dtype="float", is_dense=True,
-                        dim=DENSE_DIM)]
-    slots += [SlotConfig(name, slot_id=100 + i, capacity=CAP)
-              for i, name in enumerate(sparse_names)]
-    cfg = DataFeedConfig(slots=tuple(slots))
-
-    # -- synthetic pass data + the real feed-pass lifecycle ----------------
+    set_phase(f"{tag}:data-build", 240)
     rng = np.random.default_rng(0)
-    dataset = SlotDataset(cfg)
-    dataset._blocks = _make_blocks(rng, N_BATCHES * B, sparse_names,
-                                   N_KEYS, DENSE_DIM, CAP)
+    dataset = SlotDataset(DataFeedConfig(slots=tuple(
+        [SlotConfig("label", dtype="float", is_dense=True, dim=1),
+         SlotConfig("dense0", dtype="float", is_dense=True, dim=DENSE_DIM)]
+        + [SlotConfig(f"s{i}", slot_id=100 + i, capacity=CAP)
+           for i in range(N_SLOTS)])))
+    dataset._blocks = _make_blocks(
+        rng, n_batches * batch_size, [f"s{i}" for i in range(N_SLOTS)],
+        n_keys, DENSE_DIM, CAP)
 
+    set_phase(f"{tag}:pass-build", 300)
     engine = BoxPSEngine(EmbeddingTableConfig(
         embedding_dim=MF_DIM, shard_num=8,
         sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
@@ -142,61 +201,129 @@ def run() -> None:
     engine.begin_pass()
     # steady-state assumption: all mf created, full-width embeddings train
     engine.ws["mf_size"] = jnp.full_like(engine.ws["mf_size"], MF_DIM)
+    trace(f"{tag}: working set rows={engine.num_keys}")
 
     model = DeepFM(num_slots=N_SLOTS, emb_width=3 + MF_DIM,
                    dense_dim=DENSE_DIM, hidden=(400, 400, 400))
-    trainer = SparseTrainer(engine, model, cfg, batch_size=B,
-                            auc_table_size=100_000)
-    trainer._build_step()
+    trainer = SparseTrainer(engine, model, dataset.feed_config,
+                            batch_size=batch_size, auc_table_size=100_000)
 
-    # -- device_step: steady-state jitted step, one re-fed batch -----------
-    first = dataset.get_blocks()[0].slice(0, B)
+    set_phase(f"{tag}:compile", 600)
+    trainer._build_step()
+    first = dataset.get_blocks()[0].slice(0, batch_size)
     batch = trainer.packer.pack(first, key_mapper=engine.mapper)
     dev = trainer._put_batch(batch)
     ws, params = engine.ws, trainer.params
     opt_state, auc_state = trainer.opt_state, trainer.auc_state
+    tc = time.perf_counter()
+    ws, params, opt_state, auc_state, loss, _p = trainer._step_fn(
+        ws, params, opt_state, auc_state, *dev)
+    jax.block_until_ready(loss)
+    compile_s = time.perf_counter() - tc
+    record(**{f"{tag}_compile_s": round(compile_s, 1)})
+    trace(f"{tag}: step compiled+first-run in {compile_s:.1f}s")
+
+    # -- device_step: steady-state jitted step, one re-fed batch -----------
+    set_phase(f"{tag}:device-step", 300)
     for _ in range(STEPS_WARM):
         ws, params, opt_state, auc_state, loss, _p = trainer._step_fn(
             ws, params, opt_state, auc_state, *dev)
     jax.block_until_ready(loss)
+    trace(f"{tag}: warm done")
     t0 = time.perf_counter()
-    for _ in range(N_BATCHES):
+    for _ in range(n_batches):
         ws, params, opt_state, auc_state, loss, _p = trainer._step_fn(
             ws, params, opt_state, auc_state, *dev)
     jax.block_until_ready(loss)
-    device_eps = B * N_BATCHES / (time.perf_counter() - t0)
+    device_eps = batch_size * n_batches / (time.perf_counter() - t0)
+    record(**{("device_step" if tag == "full" else f"{tag}_device_step"):
+              round(device_eps, 1)})
+    trace(f"{tag}: device_step={device_eps:,.0f} ex/s")
     engine.ws = ws
     trainer.params = params
     trainer.opt_state = opt_state
-    trainer.auc_state = auc_state
+    # the warmup steps above accumulated the same batch into auc_state;
+    # start the measured pass clean so the reported AUC is honest
+    trainer.reset_metrics()
 
     # -- end_to_end: the real train_pass loop over fresh batches -----------
+    set_phase(f"{tag}:e2e", 600)
+    n_examples = dataset.instance_num()
+
+    def heartbeat(n):
+        # refresh the phase budget too: forward progress is not a hang
+        set_phase(f"{tag}:e2e[batch {n}/{n_batches}]", 120)
+
     t0 = time.perf_counter()
     stats = trainer.train_pass(dataset, prefetch=8,
-                               pack_threads=PACK_THREADS)
+                               pack_threads=pack_threads,
+                               progress=heartbeat)
     dt = time.perf_counter() - t0
-    n_examples = dataset.instance_num()
     e2e_eps = n_examples / dt
+    record(**{("e2e" if tag == "full" else f"{tag}_e2e"): round(e2e_eps, 1)})
+    trace(f"{tag}: e2e={e2e_eps:,.0f} ex/s over {dt:.1f}s")
+    return {"e2e": e2e_eps, "device_step": device_eps,
+            "batches": int(stats["batches"]), "examples": int(n_examples),
+            "auc": round(float(stats.get("auc", float("nan"))), 4),
+            "compile_s": round(compile_s, 1),
+            "timers": trainer.timers.report()}
 
-    _emit(e2e_eps,
-          end_to_end=round(e2e_eps, 1),
-          device_step=round(device_eps, 1),
-          batches=int(stats["batches"]),
-          examples=int(n_examples),
-          auc=round(float(stats.get("auc", float("nan"))), 4),
-          backend=backend,
-          pack_threads=PACK_THREADS,
-          timers=trainer.timers.report())
+
+def run() -> None:
+    B = int(os.environ.get("BENCH_BATCH_SIZE", 16384))
+    N_BATCHES = int(os.environ.get("BENCH_BATCHES", 30))
+    N_KEYS = int(os.environ.get("BENCH_KEYS", 2_000_000))
+    PACK_THREADS = int(os.environ.get(
+        "BENCH_PACK_THREADS", min(8, os.cpu_count() or 1)))
+
+    set_phase("backend-init", 420)
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # local validation: the image's sitecustomize pins the 'axon' TPU
+        # platform even when JAX_PLATFORMS=cpu; override via jax.config
+        # before backend init (same workaround as tests/conftest.py)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    devices = _init_devices()
+    backend = devices[0].platform
+    trace(f"backend up: {backend} x{len(devices)}")
+
+    if os.environ.get("BENCH_SKIP_SMOKE") != "1":
+        smoke = run_config(
+            "smoke",
+            int(os.environ.get("BENCH_SMOKE_BATCH", 1024)),
+            int(os.environ.get("BENCH_SMOKE_BATCHES", 2)),
+            int(os.environ.get("BENCH_SMOKE_KEYS", 100_000)), 1)
+        smoke_only = os.environ.get("BENCH_SMOKE_ONLY") == "1"
+        emit(smoke["e2e"], final=smoke_only, basis="end_to_end",
+             stage="smoke", device_step=round(smoke["device_step"], 1),
+             backend=backend, batches=smoke["batches"],
+             compile_s=smoke["compile_s"])
+        if smoke_only:
+            return
+
+    full = run_config("full", B, N_BATCHES, N_KEYS, PACK_THREADS)
+    emit(full["e2e"], final=True, basis="end_to_end", stage="full",
+         end_to_end=round(full["e2e"], 1),
+         device_step=round(full["device_step"], 1),
+         batches=full["batches"], examples=full["examples"],
+         auc=full["auc"], backend=backend, pack_threads=PACK_THREADS,
+         compile_s=full["compile_s"], timers=full["timers"])
 
 
 def main() -> None:
-    _arm_watchdog(int(os.environ.get("BENCH_TIMEOUT_S", 1500)))
+    threading.Thread(target=_watchdog, daemon=True).start()
     try:
         run()
     except Exception as e:
-        _emit(0.0, error=f"{type(e).__name__}: {e}")
+        trace(f"FAILED in phase {_STATE['phase']}: {type(e).__name__}: {e}")
+        emit(_best(), final=True, error=f"{type(e).__name__}: {e}",
+             last_phase=_STATE["phase"],
+             partial=dict(_STATE["partial"]))
         # exit 0: the driver must always find a parseable JSON line
-        sys.exit(0)
+    finally:
+        with _LOCK:
+            _STATE["done"] = True
+    sys.exit(0)
 
 
 if __name__ == "__main__":

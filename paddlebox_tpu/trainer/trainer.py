@@ -316,7 +316,8 @@ class SparseTrainer:
         return tuple(out)
 
     def train_pass(self, dataset: SlotDataset, prefetch: int = 4,
-                   pack_threads: int = 1) -> Dict[str, float]:
+                   pack_threads: int = 1,
+                   progress=None) -> Dict[str, float]:
         """Run one full pass over the dataset (≙ TrainFiles loop).
 
         Packing runs in background threads feeding a bounded channel so the
@@ -324,6 +325,9 @@ class SparseTrainer:
         fans batch assembly over a thread pool (numpy releases the GIL)
         while the bounded channel of ordered futures preserves batch order
         (≙ the per-device PackBatchTask threads, boxps_worker.cc:1259).
+
+        progress, if given, is called as progress(n_batches_done) after
+        every device step — bench/driver heartbeat hook.
         """
         if self._step_fn is None:
             self._build_step()
@@ -345,7 +349,8 @@ class SparseTrainer:
         def packer_thread():
             try:
                 for block in dataset.batches(self.batch_size):
-                    ch.put(pool.submit(pack_one, block))
+                    if not ch.put(pool.submit(pack_one, block)):
+                        break  # consumer closed the channel (failed pass)
             finally:
                 ch.close()
 
@@ -365,46 +370,71 @@ class SparseTrainer:
             dump_file = open(
                 f"{self.trainer_config.dump_path}/dump-pass-"
                 f"{self.engine.pass_id}.txt", "w")
-        while True:
-            try:
-                batch = ch.get().result()
-            except ChannelClosed:
-                break
-            dev = self._put_batch(batch)
-            with self.timers("step"):
-                out = self._step_fn(ws, params, opt_state, auc_state, *dev)
-            if self.async_dense is not None:
-                ws, params, opt_state, auc_state, loss, preds, d_params = out
-                # ≙ PushDense (boxps_worker.cc:252): grads to the CPU table
-                self.async_dense.push(d_params)
-                if (n_batches + 1) % max(
-                        self.trainer_config.sync_weight_step, 1) == 0:
-                    # ≙ PullDense snapshot refresh (boxps_worker.cc:1301)
-                    params = jax.device_put(self.async_dense.pull())
-            else:
-                ws, params, opt_state, auc_state, loss, preds = out
-            if self._check_nan and not np.isfinite(float(loss)):
-                raise FloatingPointError(
-                    f"NaN/Inf loss at batch {n_batches}")
+        try:
+            while True:
+                try:
+                    batch = ch.get().result()
+                except ChannelClosed:
+                    break
+                dev = self._put_batch(batch)
+                with self.timers("step"):
+                    out = self._step_fn(ws, params, opt_state, auc_state,
+                                        *dev)
+                if self.async_dense is not None:
+                    (ws, params, opt_state, auc_state, loss, preds,
+                     d_params) = out
+                    # ≙ PushDense (boxps_worker.cc:252): grads to the table
+                    self.async_dense.push(d_params)
+                    if (n_batches + 1) % max(
+                            self.trainer_config.sync_weight_step, 1) == 0:
+                        # ≙ PullDense snapshot refresh (boxps_worker.cc:1301)
+                        params = jax.device_put(self.async_dense.pull())
+                else:
+                    ws, params, opt_state, auc_state, loss, preds = out
+                if self._check_nan and not np.isfinite(float(loss)):
+                    raise FloatingPointError(
+                        f"NaN/Inf loss at batch {n_batches}")
+                if dump_file is not None:
+                    p = np.asarray(preds)[:batch.num_real]
+                    lbl = batch.labels[:batch.num_real]
+                    ids = batch.ins_ids or [""] * batch.num_real
+                    for i in range(batch.num_real):
+                        dump_file.write(f"{ids[i]}\t{lbl[i]:g}\t{p[i]:.6f}\n")
+                losses.append(loss)
+                n_batches += 1
+                if progress is not None:
+                    progress(n_batches)
+        finally:
+            # on any exit — including a pack-future exception or the NaN
+            # guard — unblock the producer (close is idempotent; its own
+            # finally also closes), reap it, cancel queued packs, and never
+            # leak the dump file across failed passes
+            ch.close()
+            t.join()
+            pool.shutdown(wait=False, cancel_futures=True)
             if dump_file is not None:
-                p = np.asarray(preds)[:batch.num_real]
-                lbl = batch.labels[:batch.num_real]
-                ids = batch.ins_ids or [""] * batch.num_real
-                for i in range(batch.num_real):
-                    dump_file.write(f"{ids[i]}\t{lbl[i]:g}\t{p[i]:.6f}\n")
-            losses.append(loss)
-            n_batches += 1
-        t.join()
-        pool.shutdown(wait=True)
-        if dump_file is not None:
-            dump_file.close()
+                dump_file.close()
+            # the step donates ws/params/opt/auc buffers, so the objects the
+            # engine held at entry are dead after the first step — save the
+            # latest state even on failure or the engine is left pointing at
+            # deleted buffers and can never train again.  A failure inside
+            # the step may have consumed (donated) its inputs with no output
+            # produced: save each state group only if its buffers are still
+            # alive, else None — later use then fails with a clear
+            # lifecycle error (rebuild the pass / reload the checkpoint),
+            # not a cryptic deleted-buffer crash.
+            def _alive(tree):
+                return all(not (hasattr(l, "is_deleted") and l.is_deleted())
+                           for l in jax.tree.leaves(tree))
+
+            engine.ws = ws if _alive(ws) else None
+            self.params = params if _alive(params) else None
+            self.opt_state = opt_state if _alive(opt_state) else None
+            self.auc_state = auc_state if _alive(auc_state) else None
         if self.async_dense is not None:
             self.async_dense.drain()
             params = jax.device_put(self.async_dense.pull())
-        engine.ws = ws
-        self.params = params
-        self.opt_state = opt_state
-        self.auc_state = auc_state
+            self.params = params
 
         out = self._finalize_metrics(auc_state)
         out["batches"] = n_batches
