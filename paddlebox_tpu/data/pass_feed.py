@@ -1,0 +1,211 @@
+"""Pass-scoped device-resident batch feed — whole-pass pack, once.
+
+≙ the reference's pass-scope GPU data path: SlotPaddleBoxDataFeed packs the
+whole pass on device at feed time (data_feed.h:2036, MiniBatchGpuPack
+data_feed.h:519, FillSlotValueOffsetPadBoxKernel / CopyForTensorPadBoxKernel
+data_feed.cu:1210-1318) and translates keys once per pass during the build
+(DedupKeysAndFillIdx, box_wrapper_impl.h:129) — so the train loop touches no
+per-batch host work.
+
+TPU-first shape of the same idea:
+
+* HOST, once per pass (vectorized numpy over every record at once): ragged
+  slot values -> translated pass-row ids (ONE searchsorted over the pass key
+  array for all occurrences of all batches) -> padded [S, N*B, L] planes.
+* DEVICE, once per pass: one relayout jit to the step's [N, S, L, B] layout
+  plus (for the mxu path) the per-batch sort plans (ops/sorted_spmm
+  build_plan mapped over batches) — the TPU equivalent of the reference
+  keeping the packed pass + dedup index resident on the GPU.
+* TRAIN LOOP: the jitted step takes a batch index and dynamic-slices the
+  resident arrays; per-batch host work is one integer dispatch.
+
+The per-batch host path (`data/batch_pack.py`) remains for streaming
+datasets that do not fit pass-resident.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.config import DataFeedConfig, SlotConfig
+from paddlebox_tpu.data.batch_pack import BatchPacker
+from paddlebox_tpu.data.slot_record import SlotRecordBlock
+
+
+@dataclasses.dataclass
+class HostPassArrays:
+    """Whole pass, packed host-side (numpy), batch-major."""
+
+    indices: np.ndarray    # [S, N*B, L] int32 pass-local rows (0 = padding)
+    lengths: np.ndarray    # [S, N*B] int32
+    dense: np.ndarray      # [N*B, D] float32
+    labels: np.ndarray     # [N*B] or [N*B, T] float32
+    valid: np.ndarray      # [N*B] bool
+    n_batches: int
+    batch_size: int
+    num_real: int          # records before tail padding
+    ins_ids: Optional[list] = None
+
+
+def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
+              batch_size: int, label_slot="label",
+              key_mapper=None) -> HostPassArrays:
+    """Vectorized whole-pass pack: one call per slot, one key translation
+    for every occurrence in the pass (vs per-batch searchsorted loops)."""
+    packer = BatchPacker(feed_config, batch_size, label_slot)
+    merged = SlotRecordBlock.concat(list(blocks))
+    n = merged.n
+    n_batches = max(1, -(-n // batch_size))
+    nb = n_batches * batch_size
+    S, L = len(packer.sparse_slots), packer.capacity
+
+    indices = np.zeros((S, nb, L), dtype=np.int32)
+    lengths = np.zeros((S, nb), dtype=np.int32)
+    for si, slot in enumerate(packer.sparse_slots):
+        values, offsets = merged.uint64_slots[slot.name]
+        if key_mapper is not None:
+            # translate the ragged values ONCE (real occurrences only),
+            # then pad the translated int32 plane
+            values = key_mapper(values)
+        elif len(values) and int(values.max()) > np.iinfo(np.int32).max:
+            raise ValueError(
+                "pack_pass without a key_mapper stores raw feasigns in the "
+                "int32 index plane; keys exceed int32 — pass the engine's "
+                "PassKeyMapper (engine.mapper)")
+        # _pad_ragged zero-fills positions beyond each record's length, so
+        # padding already lands on the reserved zero row — no re-mask pass
+        padded, lens = packer._pad_ragged(values, offsets, L)
+        indices[si, :n] = padded
+        lengths[si, :n] = lens
+
+    dense = np.zeros((nb, packer.dense_dim), dtype=np.float32)
+    col = 0
+    for slot in packer.dense_slots:
+        values, offsets = merged.float_slots[slot.name]
+        padded, _ = packer._pad_ragged(values, offsets, slot.dim)
+        dense[:n, col:col + slot.dim] = padded
+        col += slot.dim
+
+    multi = np.zeros((nb, len(packer.label_slots)), np.float32)
+    for t, name in enumerate(packer.label_slots):
+        src = merged.float_slots if name in merged.float_slots else \
+            merged.uint64_slots
+        if name in src:
+            lv, lo = src[name]
+            lp, _ = packer._pad_ragged(lv, lo, 1)
+            multi[:n, t] = lp[:, 0].astype(np.float32)
+    labels = multi if len(packer.label_slots) > 1 else multi[:, 0]
+
+    valid = np.zeros((nb,), dtype=bool)
+    valid[:n] = True
+    return HostPassArrays(indices=indices, lengths=lengths, dense=dense,
+                          labels=labels, valid=valid, n_batches=n_batches,
+                          batch_size=batch_size, num_real=n,
+                          ins_ids=merged.ins_ids)
+
+
+@dataclasses.dataclass
+class PackedPassFeed:
+    """Device-resident pass: stacked per-batch arrays + optional mxu plans.
+
+    data layout (step-ready, so the hot loop does zero relayout):
+      indices  [N, S, L, B] int32
+      lengths  [N, S, B]    int32
+      dense    [N, B, D]    float32
+      labels   [N, B] / [N, B, T]
+      valid    [N, B]       bool
+    plans (mxu path): each of build_plan's outputs stacked on axis 0.
+    """
+
+    data: Dict[str, jnp.ndarray]
+    n_batches: int
+    batch_size: int
+    num_real: int
+    plans: Optional[Dict[str, jnp.ndarray]] = None
+    host: Optional[HostPassArrays] = None   # kept for dump/ins_ids paths
+
+    def device_bytes(self) -> int:
+        tot = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                  for a in self.data.values())
+        if self.plans:
+            tot += sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                       for a in self.plans.values())
+        return tot
+
+
+# module-level jits so every pass with the same geometry reuses the
+# compiled relayout / plan-build executables (a fresh jit per pass would
+# re-trace + re-compile — host work this path exists to eliminate)
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _relayout(d, N: int, B: int):
+    s, nb, l = d["indices"].shape
+    out = {
+        # [S, N*B, L] -> [N, S, L, B]
+        "indices": jnp.transpose(
+            d["indices"].reshape(s, N, B, l), (1, 0, 3, 2)),
+        "lengths": jnp.transpose(
+            d["lengths"].reshape(s, N, B), (1, 0, 2)),
+        "dense": d["dense"].reshape(N, B, -1),
+        "valid": d["valid"].reshape(N, B),
+    }
+    lbl = d["labels"]
+    out["labels"] = lbl.reshape((N, B) + lbl.shape[1:])
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _build_plans(idx_all, dims):
+    from paddlebox_tpu.ops import sorted_spmm as sp
+
+    def one(idx_slb):
+        (rows2d, perm, inv_perm, ch, tl, fg, fs,
+         first_occ) = sp.build_plan(idx_slb.reshape(-1), dims)
+        return {"rows2d": rows2d, "perm": perm, "inv_perm": inv_perm,
+                "ch": ch, "tl": tl, "fg": fg, "fs": fs,
+                "first_occ": first_occ}
+    return jax.lax.map(one, idx_all)
+
+
+def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
+                sharding=None) -> PackedPassFeed:
+    """H2D once + one relayout jit into the step-ready stacked layout.
+
+    sharding: optional {name: jax.sharding.Sharding} — under a topology the
+    batch dims shard dp-wise so the resident pass is distributed, matching
+    the per-batch path's _put_batch placement."""
+    h = host_arrays
+    N, B = h.n_batches, h.batch_size
+    dev = {
+        "indices": jnp.asarray(h.indices),   # [S, N*B, L]
+        "lengths": jnp.asarray(h.lengths),
+        "dense": jnp.asarray(h.dense),
+        "labels": jnp.asarray(h.labels),
+        "valid": jnp.asarray(h.valid),
+    }
+    data = _relayout(dev, N, B)
+    if sharding is not None:
+        data = {k: jax.device_put(v, sharding[k]) if k in sharding else v
+                for k, v in data.items()}
+    return PackedPassFeed(data=data, n_batches=N, batch_size=B,
+                          num_real=h.num_real,
+                          host=h if keep_host else None)
+
+
+def precompute_plans(feed: PackedPassFeed, dims) -> None:
+    """Per-batch sorted-spmm plans, built on device in one jit and kept
+    resident (≙ the pass-scope dedup/index build of box_wrapper_impl.h:129:
+    the sort is data-independent of the training state, so it runs once at
+    pass build, never in the hot step)."""
+    feed.plans = _build_plans(feed.data["indices"], dims)
+
+
+def slice_batch(tree, i):
+    """Batch i of a stacked pytree (XLA dynamic-slice inside jit)."""
+    return jax.tree.map(
+        lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False), tree)

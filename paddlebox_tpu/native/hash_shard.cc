@@ -11,6 +11,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -110,6 +111,36 @@ void pbox_hash_find(void* h, const uint64_t* in_keys, int64_t n,
 void pbox_hash_keys(void* h, uint64_t* out) {
   auto* m = static_cast<HashShard*>(h);
   memcpy(out, m->by_row.data(), m->by_row.size() * sizeof(uint64_t));
+}
+
+// Pass-key translation hot path (≙ DedupKeysAndFillIdx,
+// box_wrapper_impl.h:129, done once per pass): key → insertion-row + 1,
+// missing/zero keys → 0 (the reserved zero-embedding row).  Read-only over
+// the table, so lookups fan out over threads.
+void pbox_hash_find_rows1_i32(void* h, const uint64_t* in_keys, int64_t n,
+                              int32_t* out_rows, int32_t n_threads) {
+  auto* m = static_cast<HashShard*>(h);
+  if (n_threads < 1) n_threads = 1;
+  auto work = [m, in_keys, out_rows](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      uint64_t k = in_keys[i];
+      int64_t row = (k == 0) ? -1 : m->find(k);
+      out_rows[i] = static_cast<int32_t>(row + 1);
+    }
+  };
+  if (n_threads == 1 || n < (1 << 16)) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t step = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * step;
+    int64_t hi = lo + step < n ? lo + step : n;
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
 }
 
 }  // extern "C"

@@ -85,7 +85,10 @@ def build_plan(rows: jnp.ndarray, dims: SpmmDims):
     rows: [p] int32 in canonical (slot, lod, batch) order.
     Returns (rows2d [n_chunks, chunk] sorted+padded, perm [p], inv_perm [p],
     chunk_ids [n_work], tile_ids [n_work], first_gather [n_work],
-    first_scatter [n_work]).  Everything vectorized — no serial scatters.
+    first_scatter [n_work], first_occ [p_pad]).  first_occ marks the first
+    occurrence of each distinct row in sorted order — lets a scatter carry an
+    exact "any one occurrence" column (e.g. the slot id) instead of a mean.
+    Everything vectorized — no serial scatters.
     """
     p, c, t = dims.p, dims.chunk, dims.tile
     iota = jnp.arange(p, dtype=jnp.int32)
@@ -93,8 +96,11 @@ def build_plan(rows: jnp.ndarray, dims: SpmmDims):
                                      num_keys=1)
     inv_perm = jax.lax.sort((perm, iota), num_keys=1)[1]
     pad = jnp.full((dims.p_pad - p,), dims.sentinel, jnp.int32)
-    rows2d = jnp.concatenate([sorted_rows, pad]).reshape(
-        dims.n_chunks, 1, c)
+    rows_padded = jnp.concatenate([sorted_rows, pad])
+    first_occ = jnp.concatenate(
+        [jnp.ones((1,), jnp.float32),
+         (rows_padded[1:] != rows_padded[:-1]).astype(jnp.float32)])
+    rows2d = rows_padded.reshape(dims.n_chunks, 1, c)
 
     tile_of = rows2d[:, 0, :] // t                          # [n_chunks, c]
     lo, hi = tile_of[:, 0], tile_of[:, -1]
@@ -118,7 +124,7 @@ def build_plan(rows: jnp.ndarray, dims: SpmmDims):
     first_s = jnp.concatenate([jnp.ones((1,), jnp.int32),
                                (tile_ids[1:] != tile_ids[:-1]).astype(
                                    jnp.int32)])
-    return rows2d, perm, inv_perm, c_of, tile_ids, first_g, first_s
+    return rows2d, perm, inv_perm, c_of, tile_ids, first_g, first_s, first_occ
 
 
 # ---------------------------------------------------------------------------

@@ -55,15 +55,41 @@ def size_bucket(n: int, align: int = 8) -> int:
 class PassKeyMapper:
     """Host-side key→pass-row translation over the sorted unique key array.
 
-    Row 0 is reserved (zero row); real keys map to rows 1..n.
+    Row 0 is reserved (zero row); real keys map to rows 1..n.  Above a size
+    threshold the lookups run through the native open-addressing hash
+    (native/hash_shard.cc — threaded, ~6x faster than np.searchsorted over
+    a multi-MB key array); the numpy binary search remains the fallback.
     """
+
+    _NATIVE_MIN = 65_536  # below this searchsorted wins (no build cost)
 
     def __init__(self, sorted_keys: np.ndarray):
         self.sorted_keys = sorted_keys  # unique, ascending, excludes 0
+        self._native = None
+        self._native_tried = False
+
+    def _native_hash(self):
+        if not self._native_tried:
+            self._native_tried = True
+            try:
+                from paddlebox_tpu.native import hash_map
+                if hash_map.available():
+                    h = hash_map.NativeKeyHash(len(self.sorted_keys))
+                    # insertion order == sorted order, so row i+1 matches
+                    # the searchsorted contract exactly
+                    h.upsert(self.sorted_keys)
+                    self._native = h
+            except Exception:
+                self._native = None
+        return self._native
 
     def __call__(self, keys: np.ndarray) -> np.ndarray:
         if len(self.sorted_keys) == 0:
             return np.zeros(len(keys), np.int32)
+        if len(keys) >= self._NATIVE_MIN and len(self.sorted_keys) >= 1024:
+            h = self._native_hash()
+            if h is not None:
+                return h.find_rows1_i32(np.asarray(keys, np.uint64))
         pos = np.searchsorted(self.sorted_keys, keys)
         pos_c = np.minimum(pos, len(self.sorted_keys) - 1)
         found = self.sorted_keys[pos_c] == keys

@@ -6,7 +6,7 @@ through the sorted one-hot-matmul kernels (ops/sorted_spmm.py), which turn
 TPU's serial gather/scatter into MXU block-sparse matmuls.  The optimizer
 is the unchanged full-table `ps.optimizer.apply_push` — the scatter kernel
 materializes the same merged per-row accumulators (`g_show`, `g_click`,
-`g_embed`, `g_embedx`, occurrence count, slot) the v1 path built with
+`g_embed`, `g_embedx`, slot) the v1 path built with
 `.at[].add`, so every optimizer rule (adagrad / shared_adam / naive) works
 and semantics match optimizer.cuh.h exactly (up to f32 summation order;
 the kernels' hi/lo bf16 split carries ~1e-5 relative error).
@@ -67,7 +67,7 @@ def pull_pool_cvm(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
     """
     s, l, b = shape_slb
     d = ws["mf"].shape[1]
-    rows2d, perm, inv_perm, ch, tl, fg, fs = plan
+    rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
     tab = _pull_table(ws, dims)
     g = sp.gather_sorted(tab, rows2d, ch, tl, fg, dims,
                          interpret=interpret)              # [12, p_pad]
@@ -102,37 +102,39 @@ def push_and_update(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
     s, l, b = idx_slb.shape
     d = ws["mf"].shape[1]
     n = ws["show"].shape[0]
-    rows2d, perm, inv_perm, ch, tl, fg, fs = plan
+    rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
 
-    # canonical per-occurrence payload [S, L, B, D+5]:
-    #   g_show, g_click, g_embed, g_mf x D, count, slot
+    # canonical per-occurrence payload [S, L, B, D+4]:
+    #   g_show, g_click, g_embed, g_mf x D, slot
     g_show = jnp.broadcast_to(ins_cvm[None, None, :, 0], (s, l, b))
     g_click = jnp.broadcast_to(ins_cvm[None, None, :, 1], (s, l, b))
     d_w = jnp.transpose(d_pooled[:, :, 2], (1, 0))         # [S, B]
     g_embed = jnp.broadcast_to(d_w[:, None, :], (s, l, b))
     d_mf = jnp.transpose(d_pooled[:, :, 3:], (1, 0, 2))    # [S, B, D]
     g_mf = jnp.broadcast_to(d_mf[:, None], (s, l, b, d))
-    ones = jnp.ones((s, l, b), jnp.float32)
     slot_col = jnp.broadcast_to(
         slot_ids.astype(jnp.float32)[:, None, None], (s, l, b))
     payload = jnp.concatenate(
         [jnp.stack([g_show, g_click, g_embed], axis=-1), g_mf,
-         jnp.stack([ones, slot_col], axis=-1)], axis=-1)   # [S,L,B,D+5]
-    flat = payload.reshape(dims.p, d + 5)
+         slot_col[..., None]], axis=-1)                    # [S,L,B,D+4]
+    flat = payload.reshape(dims.p, d + 4)
     srt = jnp.take(flat, perm, axis=0)                     # sorted domain
     srt = jnp.concatenate(
-        [srt, jnp.zeros((dims.p_pad - dims.p, d + 5), jnp.float32)])
+        [srt, jnp.zeros((dims.p_pad - dims.p, d + 4), jnp.float32)])
+    # slot column: keep only each row's FIRST occurrence (plan mask), so the
+    # scatter-sum returns that occurrence's slot exactly — no averaging, and
+    # keys appearing under several slots resolve deterministically
+    # (≙ the reference's per-key slot from its merge position,
+    # box_wrapper.cu:417 PushMergeCopy)
+    srt = srt.at[:, d + 3].mul(first_occ)
     delta = sp.scatter_add_sorted(srt.T, rows2d, ch, tl, fs, dims,
-                                  interpret=interpret)     # [D+5, n_kernel]
+                                  interpret=interpret)     # [D+4, n_kernel]
 
-    cnt = delta[d + 3, :n]
-    safe_cnt = jnp.maximum(cnt, 1.0)
     acc = {
         "g_show": delta[0, :n],
         "g_click": delta[1, :n],
         "g_embed": delta[2, :n],
         "g_embedx": delta[3:3 + d, :n].T,
-        # all occurrences of a key share its slot, so mean == the value
-        "slot": jnp.rint(delta[d + 4, :n] / safe_cnt).astype(jnp.int32),
+        "slot": jnp.rint(delta[d + 3, :n]).astype(jnp.int32),
     }
     return sparse_opt.apply_push(ws, acc, cfg)

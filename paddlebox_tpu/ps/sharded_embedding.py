@@ -101,7 +101,7 @@ def pull_rows_sharded_mxu(table_fm_local: jnp.ndarray,
     from paddlebox_tpu.ops import sorted_spmm as sp
     rows_loc = table_fm_local.shape[1]
     dims, plan = _local_plan(idx_local, rows_loc, axis)
-    rows2d, perm, inv_perm, ch, tl, fg, fs = plan
+    rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
     # pad the local block to kernel geometry (sentinel tile = zeros)
     tab = jnp.zeros((table_fm_local.shape[0], dims.n_kernel),
                     table_fm_local.dtype)
@@ -122,7 +122,7 @@ def push_rows_sharded_mxu(idx_local: jnp.ndarray,
     merge, heter_comm_inl.h:2027)."""
     from paddlebox_tpu.ops import sorted_spmm as sp
     dims, plan = _local_plan(idx_local, rows_loc, axis)
-    rows2d, perm, inv_perm, ch, tl, fg, fs = plan
+    rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
     pay_all = lax.all_gather(payload_local, axis, axis=1, tiled=True)
     srt = jnp.take(pay_all, perm, axis=1)
     srt = jnp.concatenate(

@@ -29,6 +29,7 @@ import optax
 from paddlebox_tpu.config import DataFeedConfig, TrainerConfig
 from paddlebox_tpu.data.batch_pack import BatchPacker, PackedBatch
 from paddlebox_tpu.data.dataset import SlotDataset
+from paddlebox_tpu.data.pass_feed import PackedPassFeed, slice_batch
 from paddlebox_tpu.metrics.auc import (AucCalculator, accumulate_auc,
                                        make_auc_state)
 from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
@@ -87,6 +88,8 @@ class SparseTrainer:
         self.auc_state = make_auc_state(auc_table_size)
         self.auc = AucCalculator(auc_table_size)
         self._step_fn = None
+        self._packed_step_fn = None
+        self._packed_sig = None
         self._check_nan = flags.get_flags("check_nan_inf")
 
         if topology is not None:
@@ -97,7 +100,10 @@ class SparseTrainer:
             self._replicated = None
 
     # ------------------------------------------------------------------
-    def _build_step(self):
+    def _resolve_path(self) -> str:
+        """Resolve sparse_path='auto' against the live working set; the
+        concrete value is what bench/tests assert against (a silent
+        fallback to a slow path must be observable)."""
         assert self.engine.ws is not None, \
             "engine pass lifecycle must run before building the step " \
             "(begin_feed_pass/add_keys/end_feed_pass/begin_pass)"
@@ -119,6 +125,12 @@ class SparseTrainer:
                 path = "fast"
             else:
                 path = "reference"
+        return path
+
+    def _build_step(self):
+        path = self._resolve_path()
+        has_ex = "mf_ex" in self.engine.ws
+        is_adagrad = self.engine.config.sgd.optimizer == "adagrad"
         if path == "mxu":
             if has_ex:
                 raise ValueError(
@@ -299,6 +311,242 @@ class SparseTrainer:
         self._step_fn = jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------------------------
+    # pass-resident path (≙ SlotPaddleBoxDataFeed whole-pass GPU pack,
+    # data_feed.h:2036 + data_feed.cu:1210-1318): the step takes a batch
+    # INDEX and dynamic-slices device-resident stacked arrays; plans for
+    # the mxu path are precomputed at pass-build time, so the hot step
+    # contains no sorts and no host work at all.
+    def build_pass_feed(self, dataset: SlotDataset,
+                        keep_host: bool = False) -> PackedPassFeed:
+        """Pack + translate + upload the whole pass, and (mxu path)
+        precompute the per-batch sorted-spmm plans.  Runs at pass-build
+        time — the train loop then touches no per-batch host work."""
+        from paddlebox_tpu.data import pass_feed as pf
+        assert self.engine.ws is not None, "engine lifecycle must run first"
+        label = (self.packer.label_slots
+                 if len(self.packer.label_slots) > 1 else self.packer.label_slot)
+        arrays = pf.pack_pass(dataset.get_blocks(), self.packer.config,
+                              self.batch_size, label,
+                              key_mapper=self.engine.mapper)
+        keep = keep_host or bool(self.trainer_config.dump_path)
+        shardings = None
+        if self.topology is not None:
+            # mirror _put_batch: batch dims shard dp-wise so the resident
+            # pass is distributed, not replicated on one device
+            t = self.topology
+            dp = ("dp", "sharding")
+            shardings = {
+                "indices": t.sharding(None, None, None, dp),  # [N,S,L,B]
+                "lengths": t.sharding(None, None, dp),        # [N,S,B]
+                "dense": t.sharding(None, dp, None),          # [N,B,D]
+                "labels": (t.sharding(None, dp) if arrays.labels.ndim == 1
+                           else t.sharding(None, dp, None)),
+                "valid": t.sharding(None, dp),
+            }
+        feed = pf.upload_pass(arrays, keep_host=keep, sharding=shardings)
+        if self._resolve_path() == "mxu":
+            from paddlebox_tpu.ps import mxu_path
+            n, s, l, b = feed.data["indices"].shape
+            dims = mxu_path.make_dims(s * l * b,
+                                      self.engine.ws["show"].shape[0])
+            pf.precompute_plans(feed, dims)
+        return feed
+
+    def _build_packed_step(self, feed: PackedPassFeed):
+        path = self._resolve_path()
+        sgd_cfg = self.engine.config.sgd
+        use_cvm = self.use_cvm
+        slot_ids = jnp.asarray(self.slot_ids)
+        with_plans = feed.plans is not None
+        n, s, l, b = feed.data["indices"].shape
+        async_dense = self.async_dense is not None
+
+        if path == "mxu":
+            from paddlebox_tpu.ps import mxu_path
+            interpret = jax.default_backend() == "cpu"
+            n_rows = self.engine.ws["show"].shape[0]
+            dims = mxu_path.make_dims(s * l * b, n_rows)
+            half = self._pooled_dense_half()
+
+            def step(ws, params, opt_state, auc_state, i, data, plans):
+                bt = slice_batch(data, i)
+                if with_plans:
+                    p = slice_batch(plans, i)
+                    plan = (p["rows2d"], p["perm"], p["inv_perm"], p["ch"],
+                            p["tl"], p["fg"], p["fs"], p["first_occ"])
+                else:
+                    # host pack already parked padding at row 0, so the
+                    # sliced indices are plan-ready as-is
+                    plan = mxu_path.build_plan(bt["indices"], dims)
+                pooled = jax.lax.stop_gradient(mxu_path.pull_pool_cvm(
+                    ws, plan, dims, (s, l, b), use_cvm, interpret=interpret))
+                (params, opt_state, auc_state, loss, preds, d_pooled,
+                 d_params) = half(params, opt_state, auc_state, pooled,
+                                  bt["dense"], bt["labels"], bt["valid"])
+                ins_cvm = jnp.stack(
+                    [jnp.ones_like(bt["labels"]), bt["labels"]], axis=1)
+                ws = mxu_path.push_and_update(ws, plan, dims, bt["indices"],
+                                              d_pooled, ins_cvm, slot_ids,
+                                              sgd_cfg, interpret=interpret)
+                out = (ws, params, opt_state, auc_state, loss, preds)
+                return out + ((d_params,) if async_dense else ())
+
+        elif path == "fast":
+            from paddlebox_tpu.ps import fast_path
+            half = self._pooled_dense_half()
+
+            def step(ws, params, opt_state, auc_state, i, data, plans):
+                bt = slice_batch(data, i)
+                idx, lengths = bt["indices"], bt["lengths"]
+                pooled = jax.lax.stop_gradient(
+                    fast_path.pull_pool_cvm(ws, idx, lengths, use_cvm))
+                (params, opt_state, auc_state, loss, preds, d_pooled,
+                 d_params) = half(params, opt_state, auc_state, pooled,
+                                  bt["dense"], bt["labels"], bt["valid"])
+                ins_cvm = jnp.stack(
+                    [jnp.ones_like(bt["labels"]), bt["labels"]], axis=1)
+                ws = fast_path.push_and_update(ws, idx, lengths, d_pooled,
+                                               ins_cvm, slot_ids, sgd_cfg)
+                out = (ws, params, opt_state, auc_state, loss, preds)
+                return out + ((d_params,) if async_dense else ())
+
+        else:  # reference
+            model, dense_tx, amp = self.model, self.dense_tx, self.amp
+
+            def step(ws, params, opt_state, auc_state, i, data, plans):
+                bt = slice_batch(data, i)
+                indices = jnp.transpose(bt["indices"], (0, 2, 1))  # [S,B,L]
+                lengths, dense = bt["lengths"], bt["dense"]
+                labels, valid = bt["labels"], bt["valid"]
+                emb = jax.lax.stop_gradient(
+                    embedding.pull_sparse(ws, indices))
+                ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
+
+                def loss_fn(p, e):
+                    pooled = fused_seqpool_cvm(e, lengths, ins_cvm, use_cvm)
+                    if amp:
+                        p_c = jax.tree.map(
+                            lambda a: a.astype(jnp.bfloat16), p)
+                        logits = model.apply(
+                            p_c, pooled.astype(jnp.bfloat16),
+                            dense.astype(jnp.bfloat16)).astype(jnp.float32)
+                    else:
+                        logits = model.apply(p, pooled, dense)
+                    w = valid.astype(jnp.float32)
+                    per = optax.sigmoid_binary_cross_entropy(logits, labels)
+                    loss = jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
+                    return loss, jax.nn.sigmoid(logits)
+
+                (loss, preds), (d_params, d_emb) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1), has_aux=True)(params, emb)
+                acc = embedding.push_sparse_grads(ws, indices, d_emb,
+                                                  slot_ids)
+                ws = sparse_opt.apply_push(ws, acc, sgd_cfg)
+                updates, opt_state = dense_tx.update(d_params, opt_state,
+                                                     params)
+                params = optax.apply_updates(params, updates)
+                auc_state = accumulate_auc(auc_state, preds, labels, valid)
+                return ws, params, opt_state, auc_state, loss, preds
+
+        self._packed_step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        # n_rows + feed geometry are baked into the step closure (dims),
+        # so a cross-pass table resize or re-batched feed must rebuild
+        self._packed_sig = (path, with_plans, async_dense,
+                            self.engine.ws["show"].shape[0], (n, s, l, b))
+
+    def _train_packed(self, feed: PackedPassFeed,
+                      progress=None) -> Dict[str, float]:
+        """Device-resident train loop: per-batch host work is one int32
+        dispatch (≙ the reference train loop consuming pre-packed GPU
+        batches, data_feed.h:519 MiniBatchGpuPack)."""
+        path = self._resolve_path()
+        async_dense = self.async_dense is not None
+        sig = (path, feed.plans is not None, async_dense,
+               self.engine.ws["show"].shape[0],
+               tuple(feed.data["indices"].shape))
+        if self._packed_step_fn is None or self._packed_sig != sig:
+            self._build_packed_step(feed)
+        engine = self.engine
+        ws, params = engine.ws, self.params
+        opt_state, auc_state = self.opt_state, self.auc_state
+        plans = feed.plans if feed.plans is not None else {}
+        losses = []
+        n_batches = 0
+        dump_file = None
+        if self.trainer_config.dump_path:
+            if feed.host is None:
+                raise ValueError(
+                    "dump_path requires build_pass_feed(keep_host=True)")
+            import os
+            os.makedirs(self.trainer_config.dump_path, exist_ok=True)
+            dump_file = open(
+                f"{self.trainer_config.dump_path}/dump-pass-"
+                f"{self.engine.pass_id}.txt", "w")
+        try:
+            for i in range(feed.n_batches):
+                with self.timers("step"):
+                    out = self._packed_step_fn(ws, params, opt_state,
+                                               auc_state, np.int32(i),
+                                               feed.data, plans)
+                if async_dense:
+                    (ws, params, opt_state, auc_state, loss, preds,
+                     d_params) = out
+                    self.async_dense.push(d_params)
+                    if (i + 1) % max(
+                            self.trainer_config.sync_weight_step, 1) == 0:
+                        params = jax.device_put(self.async_dense.pull())
+                else:
+                    ws, params, opt_state, auc_state, loss, preds = out
+                if self._check_nan and not np.isfinite(float(loss)):
+                    raise FloatingPointError(f"NaN/Inf loss at batch {i}")
+                if dump_file is not None:
+                    h = feed.host
+                    lo = i * feed.batch_size
+                    hi = min(lo + feed.batch_size, feed.num_real)
+                    if hi > lo:
+                        p = np.asarray(preds)[:hi - lo]
+                        lbl = np.asarray(h.labels[lo:hi])
+                        ids = (h.ins_ids[lo:hi] if h.ins_ids
+                               else [""] * (hi - lo))
+                        for j in range(hi - lo):
+                            dump_file.write(
+                                f"{ids[j]}\t{lbl[j]:g}\t{p[j]:.6f}\n")
+                losses.append(loss)
+                n_batches += 1
+                if progress is not None:
+                    progress(n_batches)
+        finally:
+            if dump_file is not None:
+                dump_file.close()
+            self._save_state(ws, params, opt_state, auc_state)
+        if async_dense:
+            self.async_dense.drain()
+            self.params = jax.device_put(self.async_dense.pull())
+        out = self._finalize_metrics(self.auc_state)
+        out["batches"] = n_batches
+        out["loss"] = float(np.mean([float(x) for x in losses])) \
+            if losses else float("nan")
+        return out
+
+    def _save_state(self, ws, params, opt_state, auc_state):
+        """The step donates ws/params/opt/auc buffers, so the objects held
+        at entry are dead after the first step — save the latest state even
+        on failure, or the engine is left pointing at deleted buffers.  A
+        failure inside the step may have consumed (donated) its inputs with
+        no output produced: save each state group only if its buffers are
+        still alive, else None — later use then fails with a clear
+        lifecycle error (rebuild the pass / reload the checkpoint), not a
+        cryptic deleted-buffer crash."""
+        def _alive(tree):
+            return all(not (hasattr(leaf, "is_deleted") and leaf.is_deleted())
+                       for leaf in jax.tree.leaves(tree))
+
+        self.engine.ws = ws if _alive(ws) else None
+        self.params = params if _alive(params) else None
+        self.opt_state = opt_state if _alive(opt_state) else None
+        self.auc_state = auc_state if _alive(auc_state) else None
+
+    # ------------------------------------------------------------------
     def _put_batch(self, batch: PackedBatch):
         arrs = (batch.indices, batch.lengths, batch.dense, batch.labels,
                 batch.valid)
@@ -328,7 +576,12 @@ class SparseTrainer:
 
         progress, if given, is called as progress(n_batches_done) after
         every device step — bench/driver heartbeat hook.
+
+        A PackedPassFeed (build_pass_feed) routes to the device-resident
+        loop instead — zero per-batch host work.
         """
+        if isinstance(dataset, PackedPassFeed):
+            return self._train_packed(dataset, progress)
         if self._step_fn is None:
             self._build_step()
         engine = self.engine
@@ -414,23 +667,7 @@ class SparseTrainer:
             pool.shutdown(wait=False, cancel_futures=True)
             if dump_file is not None:
                 dump_file.close()
-            # the step donates ws/params/opt/auc buffers, so the objects the
-            # engine held at entry are dead after the first step — save the
-            # latest state even on failure or the engine is left pointing at
-            # deleted buffers and can never train again.  A failure inside
-            # the step may have consumed (donated) its inputs with no output
-            # produced: save each state group only if its buffers are still
-            # alive, else None — later use then fails with a clear
-            # lifecycle error (rebuild the pass / reload the checkpoint),
-            # not a cryptic deleted-buffer crash.
-            def _alive(tree):
-                return all(not (hasattr(l, "is_deleted") and l.is_deleted())
-                           for l in jax.tree.leaves(tree))
-
-            engine.ws = ws if _alive(ws) else None
-            self.params = params if _alive(params) else None
-            self.opt_state = opt_state if _alive(opt_state) else None
-            self.auc_state = auc_state if _alive(auc_state) else None
+            self._save_state(ws, params, opt_state, auc_state)
         if self.async_dense is not None:
             self.async_dense.drain()
             params = jax.device_put(self.async_dense.pull())

@@ -22,7 +22,14 @@ def _run(rows_np, n_rows, w=16, chunk=8, tile=32, seed=0):
     payload = rng.normal(0, 1, (w, p)).astype(np.float32)
 
     rows = jnp.asarray(rows_np, jnp.int32)
-    rows2d, perm, inv_perm, ch, tl, fg, fs = sp.build_plan(rows, dims)
+    rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = sp.build_plan(rows,
+                                                                      dims)
+
+    # first_occ marks exactly the first occurrence of each sorted run
+    srt = np.asarray(rows2d).reshape(-1)
+    exp_first = np.concatenate([[1.0], (srt[1:] != srt[:-1]).astype(
+        np.float32)])
+    assert np.array_equal(np.asarray(first_occ), exp_first)
 
     # permutation sanity
     assert np.array_equal(np.asarray(rows)[np.asarray(perm)],
