@@ -2,8 +2,11 @@
 
 Prints JSON lines on stdout; the LAST line is the result the driver
 records.  The headline value is END-TO-END examples/s — the full
-train_pass loop (host batch packing + key translation + H2D + jitted
-train step, the loop ≙ BoxPSWorker::TrainFiles boxps_worker.cc:1278).
+train_pass loop over the pass-resident device feed (≙ the reference's
+TrainFiles loop consuming SlotPaddleBoxDataFeed's whole-pass GPU pack,
+boxps_worker.cc:1278 + data_feed.cu:1210-1318).  Pass packing/translation/
+upload happens at pass-build time, exactly where the reference does it
+(feed pass, not train), and is reported separately as `pass_pack_s`.
 `device_step` (steady re-fed device step) is reported alongside;
 `basis` names which quantity the headline value is.
 
@@ -16,13 +19,15 @@ TrainFilesWithProfiler, boxps_worker.cc:1358):
  * partial numbers (smoke/device_step/e2e) are recorded the moment they
    are measured; the watchdog emits the best value seen so far plus the
    name of the wedged phase — never a bare 0.0;
- * each phase has its own budget; a wedged phase fails fast.
+ * each phase has its own budget; a wedged phase fails fast;
+ * `step_ms` breaks the device step into pull/dense/push phases.
 
 Geometry (full): 26 sparse slots with variable lengths 1..3 (capacity 3),
 13 dense features, mf_dim=8, 2M-key working set, B=16384.
 
 Env knobs: BENCH_BATCH_SIZE, BENCH_BATCHES, BENCH_KEYS, BENCH_TIMEOUT_S,
-BENCH_PACK_THREADS, BENCH_SKIP_SMOKE=1, BENCH_SMOKE_ONLY=1.
+BENCH_PACK_THREADS, BENCH_SKIP_SMOKE=1, BENCH_SMOKE_ONLY=1,
+BENCH_LEGACY_FEED=1 (per-batch host pack path), BENCH_STEP_PROFILE=0.
 """
 
 import json
@@ -163,6 +168,71 @@ def _make_blocks(rng, n_records, sparse_names, n_keys, dense_dim, cap,
     return blocks
 
 
+def _profile_step_phases(trainer, feed, k=8):
+    """Per-phase device-time breakdown of the mxu packed step (≙ the
+    per-op timer discipline of TrainFilesWithProfiler,
+    boxps_worker.cc:1358-1407).  Each phase runs k chained iterations
+    inside one jit (a scalar carry defeats CSE and amortizes RPC latency),
+    synced by a scalar readback; the no-op floor is subtracted."""
+    import jax
+    import jax.numpy as jnp
+    from paddlebox_tpu.ps import mxu_path
+
+    ws = trainer.engine.ws
+    n_rows = ws["show"].shape[0]
+    n, s, l, b = feed.data["indices"].shape
+    dims = mxu_path.make_dims(s * l * b, n_rows)
+    interpret = jax.default_backend() == "cpu"
+    p0 = jax.tree.map(lambda a: a[0], feed.plans)
+    plan = (p0["rows2d"], p0["perm"], p0["inv_perm"], p0["ch"], p0["tl"],
+            p0["fg"], p0["fs"], p0["first_occ"])
+    bt = jax.tree.map(lambda a: a[0], feed.data)
+    half = trainer._pooled_dense_half()
+    slot_ids = jnp.asarray(trainer.slot_ids)
+    sgd_cfg = trainer.engine.config.sgd
+    pooled0 = jax.jit(lambda w: mxu_path.pull_pool_cvm(
+        w, plan, dims, (s, l, b), trainer.use_cvm, interpret=interpret))(ws)
+    ins_cvm = jnp.stack([jnp.ones_like(bt["labels"]), bt["labels"]], axis=1)
+
+    def timed(body):
+        @jax.jit
+        def run():
+            def it(i, c):
+                return body(c)
+            return jax.lax.fori_loop(0, k, it, jnp.float32(0))
+        float(run())  # compile + first run
+        t0 = time.perf_counter()
+        float(run())
+        return time.perf_counter() - t0
+
+    floor = timed(lambda c: c + ws["show"][0])
+
+    def vary(c):  # cheap data-dependence injection, defeats loop CSE
+        return {**ws, "show": ws["show"] + c}
+
+    t_pull = timed(lambda c: c + mxu_path.pull_pool_cvm(
+        vary(c), plan, dims, (s, l, b), trainer.use_cvm,
+        interpret=interpret).sum())
+
+    def dense_body(c):
+        out = half(trainer.params, trainer.opt_state, trainer.auc_state,
+                   pooled0 + c, bt["dense"], bt["labels"], bt["valid"])
+        return c + out[3]  # loss
+    t_dense = timed(dense_body)
+
+    def push_body(c):
+        w2 = mxu_path.push_and_update(vary(c), plan, dims, bt["indices"],
+                                      pooled0 + c, ins_cvm, slot_ids,
+                                      sgd_cfg, interpret=interpret)
+        return c + w2["show"][0]
+    t_push = timed(push_body)
+
+    out = {name: max(0.0, (t - floor) / k * 1e3)
+           for name, t in (("pull_pool", t_pull), ("dense_fwd_bwd", t_dense),
+                           ("push_optimizer", t_push))}
+    return {key: round(v, 2) for key, v in out.items()}
+
+
 def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     """One full bench at a given geometry.  Returns the results dict;
     records partials into _STATE as they are measured."""
@@ -190,7 +260,7 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
         rng, n_batches * batch_size, [f"s{i}" for i in range(N_SLOTS)],
         n_keys, DENSE_DIM, CAP)
 
-    set_phase(f"{tag}:pass-build", 300)
+    set_phase(f"{tag}:pass-build", 420)
     engine = BoxPSEngine(EmbeddingTableConfig(
         embedding_dim=MF_DIM, shard_num=8,
         sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
@@ -207,17 +277,46 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
                    dense_dim=DENSE_DIM, hidden=(400, 400, 400))
     trainer = SparseTrainer(engine, model, dataset.feed_config,
                             batch_size=batch_size, auc_table_size=100_000)
+    assert trainer._resolve_path() == "mxu", trainer._resolve_path()
+
+    # pass-resident feed: pack + translate + upload + plans at pass-build
+    # time (≙ SlotPaddleBoxDataFeed feed-time GPU pack + DedupKeysAndFillIdx,
+    # data_feed.cu:1210-1318 / box_wrapper_impl.h:129)
+    legacy = os.environ.get("BENCH_LEGACY_FEED") == "1"
+    feed = None
+    pack_s = 0.0
+    if not legacy:
+        t0 = time.perf_counter()
+        feed = trainer.build_pass_feed(dataset)
+        jax.block_until_ready(feed.plans["perm"] if feed.plans is not None
+                              else feed.data["indices"])
+        pack_s = time.perf_counter() - t0
+        record(**{f"{tag}_pass_pack_s": round(pack_s, 1)})
+        trace(f"{tag}: pass feed built in {pack_s:.1f}s "
+              f"({feed.device_bytes() / 1e6:.0f} MB device-resident)")
 
     set_phase(f"{tag}:compile", 600)
-    trainer._build_step()
-    first = dataset.get_blocks()[0].slice(0, batch_size)
-    batch = trainer.packer.pack(first, key_mapper=engine.mapper)
-    dev = trainer._put_batch(batch)
     ws, params = engine.ws, trainer.params
     opt_state, auc_state = trainer.opt_state, trainer.auc_state
     tc = time.perf_counter()
-    ws, params, opt_state, auc_state, loss, _p = trainer._step_fn(
-        ws, params, opt_state, auc_state, *dev)
+    if legacy:
+        trainer._build_step()
+        first = dataset.get_blocks()[0].slice(0, batch_size)
+        batch = trainer.packer.pack(first, key_mapper=engine.mapper)
+        dev = trainer._put_batch(batch)
+
+        def one_step(w, p, o, a):
+            return trainer._step_fn(w, p, o, a, *dev)
+    else:
+        trainer._build_packed_step(feed)
+        i0 = np.int32(0)
+        plans = feed.plans if feed.plans is not None else {}
+
+        def one_step(w, p, o, a):
+            return trainer._packed_step_fn(w, p, o, a, i0, feed.data, plans)
+
+    ws, params, opt_state, auc_state, loss, _p = one_step(
+        ws, params, opt_state, auc_state)
     jax.block_until_ready(loss)
     compile_s = time.perf_counter() - tc
     record(**{f"{tag}_compile_s": round(compile_s, 1)})
@@ -226,14 +325,14 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     # -- device_step: steady-state jitted step, one re-fed batch -----------
     set_phase(f"{tag}:device-step", 300)
     for _ in range(STEPS_WARM):
-        ws, params, opt_state, auc_state, loss, _p = trainer._step_fn(
-            ws, params, opt_state, auc_state, *dev)
+        ws, params, opt_state, auc_state, loss, _p = one_step(
+            ws, params, opt_state, auc_state)
     jax.block_until_ready(loss)
     trace(f"{tag}: warm done")
     t0 = time.perf_counter()
     for _ in range(n_batches):
-        ws, params, opt_state, auc_state, loss, _p = trainer._step_fn(
-            ws, params, opt_state, auc_state, *dev)
+        ws, params, opt_state, auc_state, loss, _p = one_step(
+            ws, params, opt_state, auc_state)
     jax.block_until_ready(loss)
     device_eps = batch_size * n_batches / (time.perf_counter() - t0)
     record(**{("device_step" if tag == "full" else f"{tag}_device_step"):
@@ -246,7 +345,7 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     # start the measured pass clean so the reported AUC is honest
     trainer.reset_metrics()
 
-    # -- end_to_end: the real train_pass loop over fresh batches -----------
+    # -- end_to_end: the real train_pass loop ------------------------------
     set_phase(f"{tag}:e2e", 600)
     n_examples = dataset.instance_num()
 
@@ -255,18 +354,32 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
         set_phase(f"{tag}:e2e[batch {n}/{n_batches}]", 120)
 
     t0 = time.perf_counter()
-    stats = trainer.train_pass(dataset, prefetch=8,
-                               pack_threads=pack_threads,
-                               progress=heartbeat)
+    if legacy:
+        stats = trainer.train_pass(dataset, prefetch=8,
+                                   pack_threads=pack_threads,
+                                   progress=heartbeat)
+    else:
+        stats = trainer.train_pass(feed, progress=heartbeat)
     dt = time.perf_counter() - t0
     e2e_eps = n_examples / dt
     record(**{("e2e" if tag == "full" else f"{tag}_e2e"): round(e2e_eps, 1)})
     trace(f"{tag}: e2e={e2e_eps:,.0f} ex/s over {dt:.1f}s")
+
+    step_ms = {}
+    if tag == "full" and not legacy \
+            and os.environ.get("BENCH_STEP_PROFILE", "1") == "1":
+        set_phase(f"{tag}:step-profile", 300)
+        try:
+            step_ms = _profile_step_phases(trainer, feed)
+            trace(f"{tag}: step phases {step_ms}")
+        except Exception as e:  # profile is diagnostic, never fatal
+            trace(f"{tag}: step profile failed: {type(e).__name__}: {e}")
+
     return {"e2e": e2e_eps, "device_step": device_eps,
             "batches": int(stats["batches"]), "examples": int(n_examples),
             "auc": round(float(stats.get("auc", float("nan"))), 4),
-            "compile_s": round(compile_s, 1),
-            "timers": trainer.timers.report()}
+            "compile_s": round(compile_s, 1), "pass_pack_s": round(pack_s, 1),
+            "step_ms": step_ms, "timers": trainer.timers.report()}
 
 
 def run() -> None:
@@ -307,7 +420,8 @@ def run() -> None:
          device_step=round(full["device_step"], 1),
          batches=full["batches"], examples=full["examples"],
          auc=full["auc"], backend=backend, pack_threads=PACK_THREADS,
-         compile_s=full["compile_s"], timers=full["timers"])
+         compile_s=full["compile_s"], pass_pack_s=full["pass_pack_s"],
+         step_ms=full["step_ms"], timers=full["timers"])
 
 
 def main() -> None:

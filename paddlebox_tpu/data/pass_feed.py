@@ -128,6 +128,7 @@ class PackedPassFeed:
     batch_size: int
     num_real: int
     plans: Optional[Dict[str, jnp.ndarray]] = None
+    plan_dims: object = None                # SpmmDims the plans were built for
     host: Optional[HostPassArrays] = None   # kept for dump/ins_ids paths
 
     def device_bytes(self) -> int:
@@ -178,15 +179,36 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
 
     sharding: optional {name: jax.sharding.Sharding} — under a topology the
     batch dims shard dp-wise so the resident pass is distributed, matching
-    the per-batch path's _put_batch placement."""
+    the per-batch path's _put_batch placement.  The H2D upload itself is
+    already sharded (record dim split over the mesh) so the full pass never
+    materializes on a single device; the relayout then runs under GSPMD and
+    the result is device_put to the final batch-dim shardings."""
     h = host_arrays
     N, B = h.n_batches, h.batch_size
+    in_shardings = {}
+    if sharding is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = next(iter(sharding.values())).mesh
+        spec = sharding["valid"].spec[1]    # the dp axes tuple
+        in_shardings = {
+            "indices": NamedSharding(mesh, P(None, spec, None)),
+            "lengths": NamedSharding(mesh, P(None, spec)),
+            "dense": NamedSharding(mesh, P(spec)),
+            "labels": NamedSharding(mesh, P(spec)),
+            "valid": NamedSharding(mesh, P(spec)),
+        }
+
+    def put(name, a):
+        if name in in_shardings:
+            return jax.device_put(a, in_shardings[name])
+        return jnp.asarray(a)
+
     dev = {
-        "indices": jnp.asarray(h.indices),   # [S, N*B, L]
-        "lengths": jnp.asarray(h.lengths),
-        "dense": jnp.asarray(h.dense),
-        "labels": jnp.asarray(h.labels),
-        "valid": jnp.asarray(h.valid),
+        "indices": put("indices", h.indices),   # [S, N*B, L]
+        "lengths": put("lengths", h.lengths),
+        "dense": put("dense", h.dense),
+        "labels": put("labels", h.labels),
+        "valid": put("valid", h.valid),
     }
     data = _relayout(dev, N, B)
     if sharding is not None:
@@ -203,6 +225,7 @@ def precompute_plans(feed: PackedPassFeed, dims) -> None:
     the sort is data-independent of the training state, so it runs once at
     pass build, never in the hot step)."""
     feed.plans = _build_plans(feed.data["indices"], dims)
+    feed.plan_dims = dims
 
 
 def slice_batch(tree, i):

@@ -127,8 +127,10 @@ class SparseTrainer:
                 path = "reference"
         return path
 
-    def _build_step(self):
-        path = self._resolve_path()
+    def _validate_path(self, path: str) -> None:
+        """Reject configs a path cannot honor — both the per-batch and the
+        packed builders go through here, so an invalid explicit path raises
+        instead of silently training wrong."""
         has_ex = "mf_ex" in self.engine.ws
         is_adagrad = self.engine.config.sgd.optimizer == "adagrad"
         if path == "mxu":
@@ -136,20 +138,33 @@ class SparseTrainer:
                 raise ValueError(
                     "sparse_path='mxu' does not support extended (mf_ex) "
                     "tables — use 'fast' or 'reference'")
-            return self._build_step_mxu()
-        if path == "fast":
+        elif path == "fast":
             if not is_adagrad:
                 raise ValueError(
                     "sparse_path='fast' implements the adagrad rule only "
                     f"(got {self.engine.config.sgd.optimizer!r})")
-            return self._build_step_fast()
-        if path != "reference":
+        elif path == "reference":
+            if self.async_dense is not None:
+                raise ValueError(
+                    "dense_sync_mode='async_table' requires the mxu or "
+                    "fast sparse path")
+        else:
             raise ValueError(f"unknown sparse_path {path!r}")
-        if self.async_dense is not None:
-            raise ValueError(
-                "dense_sync_mode='async_table' requires the mxu or fast "
-                "sparse path")
-        return self._build_step_reference()
+
+    def _build_step(self):
+        """Per-batch jitted step: takes [S, B, L] indices from the host
+        packer (transposed + planned in-step)."""
+        path = self._resolve_path()
+        self._validate_path(path)
+        core = self._make_core(path)
+
+        def step(ws, params, opt_state, auc_state, indices, lengths, dense,
+                 labels, valid):
+            idx_slb = jnp.transpose(indices, (0, 2, 1))    # [S, L, B]
+            return core(ws, params, opt_state, auc_state, idx_slb, lengths,
+                        dense, labels, valid, None)
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     def _pooled_dense_half(self):
         """Shared back half of the pooled-based steps (mxu/fast): dense
@@ -192,84 +207,84 @@ class SparseTrainer:
 
         return half
 
-    def _build_step_mxu(self):
-        """Sorted-SpMM step (ps/mxu_path.py): the pull/push embedding
-        traffic runs as MXU one-hot matmuls instead of XLA's serial
-        gather/scatter — ~7x faster end-to-end on v5e."""
-        from paddlebox_tpu.ps import mxu_path
+    def _make_core(self, path: str):
+        """Shared per-path step body, used by BOTH the per-batch and the
+        pass-resident builders (single source of step semantics).
+
+        core(ws, params, opt_state, auc_state, idx_slb, lengths, dense,
+             labels, valid, plan) -> (ws, params, opt_state, auc_state,
+             loss, preds[, d_params])
+        idx_slb is [S, L, B]; plan is a precomputed sorted-spmm plan for the
+        mxu path (None → mask + build in-step).
+        """
         sgd_cfg = self.engine.config.sgd
         use_cvm = self.use_cvm
         slot_ids = jnp.asarray(self.slot_ids)
-        interpret = jax.default_backend() == "cpu"
-        half = self._pooled_dense_half()
-
-        def step(ws, params, opt_state, auc_state, indices, lengths, dense,
-                 labels, valid):
-            idx = jnp.transpose(indices, (0, 2, 1))        # [S, L, B]
-            s, l, b = idx.shape
-            # the packer already parks padding at row 0 (batch_pack.py); the
-            # mask here makes the step safe for hand-built batches too
-            idx = jnp.where(jnp.arange(l)[None, :, None]
-                            < lengths[:, None, :], idx, 0)
-            # geometry from the *traced* working set, so per-pass table
-            # resizes retrace with correct dims (and a correct sentinel)
-            n_rows = ws["show"].shape[0]
-            dims = mxu_path.make_dims(s * l * b, n_rows)
-            plan = mxu_path.build_plan(idx, dims)
-            pooled = jax.lax.stop_gradient(mxu_path.pull_pool_cvm(
-                ws, plan, dims, (s, l, b), use_cvm, interpret=interpret))
-            (params, opt_state, auc_state, loss, preds, d_pooled,
-             d_params) = half(
-                params, opt_state, auc_state, pooled, dense, labels, valid)
-            ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
-            ws = mxu_path.push_and_update(ws, plan, dims, idx, d_pooled,
-                                          ins_cvm, slot_ids, sgd_cfg,
-                                          interpret=interpret)
-            out = (ws, params, opt_state, auc_state, loss, preds)
-            return out + ((d_params,) if async_dense else ())
-
         async_dense = self.async_dense is not None
-        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
-    def _build_step_fast(self):
-        """Tiling-aware step (see ps/fast_path.py docstring); numerically
-        identical to the reference step — tests/test_fast_path.py."""
-        from paddlebox_tpu.ps import fast_path
-        sgd_cfg = self.engine.config.sgd
-        use_cvm = self.use_cvm
-        slot_ids = jnp.asarray(self.slot_ids)
-        half = self._pooled_dense_half()
+        if path == "mxu":
+            # Sorted-SpMM step (ps/mxu_path.py): the pull/push embedding
+            # traffic runs as MXU one-hot matmuls instead of XLA's serial
+            # gather/scatter
+            from paddlebox_tpu.ps import mxu_path
+            interpret = jax.default_backend() == "cpu"
+            half = self._pooled_dense_half()
 
-        def step(ws, params, opt_state, auc_state, indices, lengths, dense,
-                 labels, valid):
-            idx = jnp.transpose(indices, (0, 2, 1))        # [S, L, B]
-            pooled = jax.lax.stop_gradient(
-                fast_path.pull_pool_cvm(ws, idx, lengths, use_cvm))
-            (params, opt_state, auc_state, loss, preds, d_pooled,
-             d_params) = half(
-                params, opt_state, auc_state, pooled, dense, labels, valid)
-            ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
-            ws = fast_path.push_and_update(ws, idx, lengths, d_pooled,
-                                           ins_cvm, slot_ids, sgd_cfg)
-            out = (ws, params, opt_state, auc_state, loss, preds)
-            return out + ((d_params,) if async_dense else ())
+            def core(ws, params, opt_state, auc_state, idx_slb, lengths,
+                     dense, labels, valid, plan):
+                s, l, b = idx_slb.shape
+                # geometry from the *traced* working set, so per-pass table
+                # resizes retrace with correct dims (and correct sentinel)
+                dims = mxu_path.make_dims(s * l * b, ws["show"].shape[0])
+                if plan is None:
+                    # the packer parks padding at row 0 (batch_pack.py);
+                    # the mask makes in-step planning safe for hand-built
+                    # batches too.  Precomputed plans were built from
+                    # pack_pass output, which guarantees the same.
+                    idx_slb = jnp.where(jnp.arange(l)[None, :, None]
+                                        < lengths[:, None, :], idx_slb, 0)
+                    plan = mxu_path.build_plan(idx_slb, dims)
+                pooled = jax.lax.stop_gradient(mxu_path.pull_pool_cvm(
+                    ws, plan, dims, (s, l, b), use_cvm, interpret=interpret))
+                (params, opt_state, auc_state, loss, preds, d_pooled,
+                 d_params) = half(params, opt_state, auc_state, pooled,
+                                  dense, labels, valid)
+                ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
+                ws = mxu_path.push_and_update(ws, plan, dims, idx_slb,
+                                              d_pooled, ins_cvm, slot_ids,
+                                              sgd_cfg, interpret=interpret)
+                out = (ws, params, opt_state, auc_state, loss, preds)
+                return out + ((d_params,) if async_dense else ())
+            return core
 
-        async_dense = self.async_dense is not None
-        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        if path == "fast":
+            # tiling-aware step (ps/fast_path.py docstring); numerically
+            # identical to the reference step — tests/test_fast_path.py
+            from paddlebox_tpu.ps import fast_path
+            half = self._pooled_dense_half()
 
-    def _build_step_reference(self):
-        sgd_cfg = self.engine.config.sgd
-        use_cvm = self.use_cvm
-        model = self.model
-        dense_tx = self.dense_tx
-        amp = self.amp
-        slot_ids = jnp.asarray(self.slot_ids)
+            def core(ws, params, opt_state, auc_state, idx_slb, lengths,
+                     dense, labels, valid, plan):
+                pooled = jax.lax.stop_gradient(
+                    fast_path.pull_pool_cvm(ws, idx_slb, lengths, use_cvm))
+                (params, opt_state, auc_state, loss, preds, d_pooled,
+                 d_params) = half(params, opt_state, auc_state, pooled,
+                                  dense, labels, valid)
+                ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
+                ws = fast_path.push_and_update(ws, idx_slb, lengths,
+                                               d_pooled, ins_cvm, slot_ids,
+                                               sgd_cfg)
+                out = (ws, params, opt_state, auc_state, loss, preds)
+                return out + ((d_params,) if async_dense else ())
+            return core
 
-        def step(ws, params, opt_state, auc_state, indices, lengths, dense,
-                 labels, valid):
+        model, dense_tx, amp = self.model, self.dense_tx, self.amp
+
+        def core(ws, params, opt_state, auc_state, idx_slb, lengths, dense,
+                 labels, valid, plan):
+            indices = jnp.transpose(idx_slb, (0, 2, 1))    # [S, B, L]
             # 1. pull (≙ PullSparseCaseGPU box_wrapper_impl.h:25)
-            emb = embedding.pull_sparse(ws, indices)
-            emb = jax.lax.stop_gradient(emb)
+            emb = jax.lax.stop_gradient(embedding.pull_sparse(ws, indices))
             ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
 
             # 2-3. forward + backward over (dense params, pulled embeddings)
@@ -307,8 +322,7 @@ class SparseTrainer:
             auc_state = accumulate_auc(auc_state, preds, labels, valid)
             return ws, params, opt_state, auc_state, loss, preds
 
-        donate = (0, 1, 2, 3)
-        self._step_fn = jax.jit(step, donate_argnums=donate)
+        return core
 
     # ------------------------------------------------------------------
     # pass-resident path (≙ SlotPaddleBoxDataFeed whole-pass GPU pack,
@@ -353,104 +367,29 @@ class SparseTrainer:
         return feed
 
     def _build_packed_step(self, feed: PackedPassFeed):
+        """Thin wrapper over the same per-path core as _build_step: slice
+        the resident arrays (and the precomputed plan) by batch index."""
         path = self._resolve_path()
-        sgd_cfg = self.engine.config.sgd
-        use_cvm = self.use_cvm
-        slot_ids = jnp.asarray(self.slot_ids)
+        self._validate_path(path)
+        core = self._make_core(path)
         with_plans = feed.plans is not None
         n, s, l, b = feed.data["indices"].shape
         async_dense = self.async_dense is not None
 
-        if path == "mxu":
-            from paddlebox_tpu.ps import mxu_path
-            interpret = jax.default_backend() == "cpu"
-            n_rows = self.engine.ws["show"].shape[0]
-            dims = mxu_path.make_dims(s * l * b, n_rows)
-            half = self._pooled_dense_half()
-
-            def step(ws, params, opt_state, auc_state, i, data, plans):
-                bt = slice_batch(data, i)
-                if with_plans:
-                    p = slice_batch(plans, i)
-                    plan = (p["rows2d"], p["perm"], p["inv_perm"], p["ch"],
-                            p["tl"], p["fg"], p["fs"], p["first_occ"])
-                else:
-                    # host pack already parked padding at row 0, so the
-                    # sliced indices are plan-ready as-is
-                    plan = mxu_path.build_plan(bt["indices"], dims)
-                pooled = jax.lax.stop_gradient(mxu_path.pull_pool_cvm(
-                    ws, plan, dims, (s, l, b), use_cvm, interpret=interpret))
-                (params, opt_state, auc_state, loss, preds, d_pooled,
-                 d_params) = half(params, opt_state, auc_state, pooled,
-                                  bt["dense"], bt["labels"], bt["valid"])
-                ins_cvm = jnp.stack(
-                    [jnp.ones_like(bt["labels"]), bt["labels"]], axis=1)
-                ws = mxu_path.push_and_update(ws, plan, dims, bt["indices"],
-                                              d_pooled, ins_cvm, slot_ids,
-                                              sgd_cfg, interpret=interpret)
-                out = (ws, params, opt_state, auc_state, loss, preds)
-                return out + ((d_params,) if async_dense else ())
-
-        elif path == "fast":
-            from paddlebox_tpu.ps import fast_path
-            half = self._pooled_dense_half()
-
-            def step(ws, params, opt_state, auc_state, i, data, plans):
-                bt = slice_batch(data, i)
-                idx, lengths = bt["indices"], bt["lengths"]
-                pooled = jax.lax.stop_gradient(
-                    fast_path.pull_pool_cvm(ws, idx, lengths, use_cvm))
-                (params, opt_state, auc_state, loss, preds, d_pooled,
-                 d_params) = half(params, opt_state, auc_state, pooled,
-                                  bt["dense"], bt["labels"], bt["valid"])
-                ins_cvm = jnp.stack(
-                    [jnp.ones_like(bt["labels"]), bt["labels"]], axis=1)
-                ws = fast_path.push_and_update(ws, idx, lengths, d_pooled,
-                                               ins_cvm, slot_ids, sgd_cfg)
-                out = (ws, params, opt_state, auc_state, loss, preds)
-                return out + ((d_params,) if async_dense else ())
-
-        else:  # reference
-            model, dense_tx, amp = self.model, self.dense_tx, self.amp
-
-            def step(ws, params, opt_state, auc_state, i, data, plans):
-                bt = slice_batch(data, i)
-                indices = jnp.transpose(bt["indices"], (0, 2, 1))  # [S,B,L]
-                lengths, dense = bt["lengths"], bt["dense"]
-                labels, valid = bt["labels"], bt["valid"]
-                emb = jax.lax.stop_gradient(
-                    embedding.pull_sparse(ws, indices))
-                ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
-
-                def loss_fn(p, e):
-                    pooled = fused_seqpool_cvm(e, lengths, ins_cvm, use_cvm)
-                    if amp:
-                        p_c = jax.tree.map(
-                            lambda a: a.astype(jnp.bfloat16), p)
-                        logits = model.apply(
-                            p_c, pooled.astype(jnp.bfloat16),
-                            dense.astype(jnp.bfloat16)).astype(jnp.float32)
-                    else:
-                        logits = model.apply(p, pooled, dense)
-                    w = valid.astype(jnp.float32)
-                    per = optax.sigmoid_binary_cross_entropy(logits, labels)
-                    loss = jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
-                    return loss, jax.nn.sigmoid(logits)
-
-                (loss, preds), (d_params, d_emb) = jax.value_and_grad(
-                    loss_fn, argnums=(0, 1), has_aux=True)(params, emb)
-                acc = embedding.push_sparse_grads(ws, indices, d_emb,
-                                                  slot_ids)
-                ws = sparse_opt.apply_push(ws, acc, sgd_cfg)
-                updates, opt_state = dense_tx.update(d_params, opt_state,
-                                                     params)
-                params = optax.apply_updates(params, updates)
-                auc_state = accumulate_auc(auc_state, preds, labels, valid)
-                return ws, params, opt_state, auc_state, loss, preds
+        def step(ws, params, opt_state, auc_state, i, data, plans):
+            bt = slice_batch(data, i)
+            plan = None
+            if with_plans:
+                p = slice_batch(plans, i)
+                plan = (p["rows2d"], p["perm"], p["inv_perm"], p["ch"],
+                        p["tl"], p["fg"], p["fs"], p["first_occ"])
+            return core(ws, params, opt_state, auc_state, bt["indices"],
+                        bt["lengths"], bt["dense"], bt["labels"],
+                        bt["valid"], plan)
 
         self._packed_step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
-        # n_rows + feed geometry are baked into the step closure (dims),
-        # so a cross-pass table resize or re-batched feed must rebuild
+        # n_rows + feed geometry drive retrace via shapes, but the plan
+        # presence/path/async flags are trace-structural — key them
         self._packed_sig = (path, with_plans, async_dense,
                             self.engine.ws["show"].shape[0], (n, s, l, b))
 
@@ -461,6 +400,20 @@ class SparseTrainer:
         batches, data_feed.h:519 MiniBatchGpuPack)."""
         path = self._resolve_path()
         async_dense = self.async_dense is not None
+        if feed.plans is not None and path == "mxu":
+            # plans encode the table geometry (sentinel tile, worklist);
+            # a cross-pass resize makes them silently corrupting, not just
+            # stale — refuse and demand a rebuilt feed
+            from paddlebox_tpu.ps import mxu_path
+            n, s, l, b = feed.data["indices"].shape
+            cur = mxu_path.make_dims(s * l * b,
+                                     self.engine.ws["show"].shape[0])
+            if cur != feed.plan_dims:
+                raise ValueError(
+                    "PackedPassFeed plans were built for table dims "
+                    f"{feed.plan_dims}, but the working set now needs "
+                    f"{cur} — rebuild the feed (build_pass_feed) after a "
+                    "table resize")
         sig = (path, feed.plans is not None, async_dense,
                self.engine.ws["show"].shape[0],
                tuple(feed.data["indices"].shape))

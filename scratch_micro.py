@@ -1,0 +1,76 @@
+"""Microbench: crossing strategies + kernel tile geometry on the real chip."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+P = 1_277_952          # padded occurrences at driver geometry
+N_ROWS = 2_000_000
+W = 12
+
+rng = np.random.default_rng(0)
+perm_np = rng.permutation(P).astype(np.int32)
+vals_np = rng.random((P, W), dtype=np.float32)
+
+perm = jnp.asarray(perm_np)
+vals = jnp.asarray(vals_np)
+
+
+def timeit(name, fn, *args, n=20):
+    fn_j = jax.jit(fn)
+    out = fn_j(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn_j(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:44s} {dt*1e3:8.2f} ms")
+    return dt
+
+
+# --- crossing strategies ---------------------------------------------------
+timeit("take rows [P,12] f32", lambda v, p: jnp.take(v, p, axis=0), vals, perm)
+timeit("take rows [P,12] bf16",
+       lambda v, p: jnp.take(v.astype(jnp.bfloat16), p, axis=0), vals, perm)
+timeit("take rows [P,4] f32",
+       lambda v, p: jnp.take(v[:, :4], p, axis=0), vals, perm)
+timeit("take rows [P,1] f32",
+       lambda v, p: jnp.take(v[:, 0], p, axis=0), vals, perm)
+timeit("take rows [P//4, 48] f32 (4x fewer, 4x wider)",
+       lambda v, p: jnp.take(v.reshape(P // 4, 4 * W), p[: P // 4] // 4, axis=0),
+       vals, perm)
+# sort-as-permute: sort by key=inv_perm carrying the 12 floats
+timeit("lax.sort key+12xf32 payload",
+       lambda p, v: jax.lax.sort((p,) + tuple(v[:, i] for i in range(W)),
+                                 num_keys=1), perm, vals)
+timeit("lax.sort key+payload-as-2d? key + 3 f32",
+       lambda p, v: jax.lax.sort((p, v[:, 0], v[:, 1], v[:, 2]), num_keys=1),
+       perm, vals)
+timeit("lax.sort key only", lambda p: jax.lax.sort(p), perm)
+# permutation as argsort application via take of wide rows reshaped - n/a
+
+# --- kernel geometry -------------------------------------------------------
+from paddlebox_tpu.ops import sorted_spmm as sp
+
+idx_np = np.sort(rng.integers(1, N_ROWS, size=P).astype(np.int32))
+for chunk, tile in [(512, 2048), (1024, 4096), (2048, 4096), (1024, 8192),
+                    (2048, 8192)]:
+    dims = sp.spmm_dims(P, N_ROWS, chunk=chunk, tile=tile)
+    rows = jnp.asarray(idx_np)
+    plan = jax.jit(lambda r: sp.build_plan(r, dims))(rows)
+    rows2d, perm2, inv2, ch, tl, fg, fs = plan
+    tab = jnp.asarray(rng.random((W, dims.n_kernel), dtype=np.float32))
+    try:
+        t = timeit(f"gather kernel c={chunk} t={tile} n_work={dims.n_work}",
+                   lambda t_, r: sp.gather_sorted(t_, r, ch, tl, fg, dims),
+                   tab, rows2d)
+    except Exception as e:
+        print(f"gather c={chunk} t={tile} FAILED: {type(e).__name__}: {e}")
+    pay = jnp.asarray(rng.random((W + 1, dims.p_pad), dtype=np.float32))
+    try:
+        t = timeit(f"scatter kernel c={chunk} t={tile}",
+                   lambda p_, r: sp.scatter_add_sorted(p_, r, ch, tl, fs, dims),
+                   pay, rows2d)
+    except Exception as e:
+        print(f"scatter c={chunk} t={tile} FAILED: {type(e).__name__}: {e}")

@@ -1,0 +1,54 @@
+"""Careful re-measurement: per-iteration block, correctness check."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+P = 1_277_952
+W = 12
+rng = np.random.default_rng(0)
+perm_np = rng.permutation(P).astype(np.int32)
+vals_np = rng.random((P, W), dtype=np.float32)
+perm = jnp.asarray(perm_np)
+vals = jnp.asarray(vals_np)
+
+
+def timeit(name, fn, *args, n=10):
+    fn_j = jax.jit(fn)
+    out = fn_j(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn_j(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:44s} med={np.median(ts)*1e3:8.2f} ms  min={min(ts)*1e3:.2f}")
+    return out
+
+
+o = timeit("take rows [P,12] f32", lambda v, p: jnp.take(v, p, axis=0),
+           vals, perm)
+# correctness
+exp = vals_np[perm_np[:100]]
+got = np.asarray(o[:100])
+print("take correct:", np.allclose(exp, got))
+
+timeit("transpose [12,P] -> [P,12]",
+       lambda v: v.T.reshape(P, W) + 0.0, vals.T + 0.0)
+timeit("take + transpose chained",
+       lambda g, p: jnp.take(g.T, p, axis=0), vals.T + 0.0, perm)
+o2 = timeit("sort key + 12 payload cols",
+            lambda p, v: jax.lax.sort((p,) + tuple(v[:, i] for i in range(W)),
+                                      num_keys=1), perm, vals)
+# verify sort-permute semantics: sorting (inv_perm, vals) by key gives vals[perm]
+inv_np = np.empty_like(perm_np)
+inv_np[perm_np] = np.arange(P, dtype=np.int32)
+inv = jnp.asarray(inv_np)
+o3 = jax.jit(lambda k, v: jax.lax.sort((k,) + tuple(v[:, i] for i in range(W)),
+                                       num_keys=1))(inv, vals)
+got3 = np.stack([np.asarray(c[:100]) for c in o3[1:]], axis=1)
+print("sort-permute correct:", np.allclose(vals_np[perm_np[:100]], got3))
+
+# device->host roundtrip sanity: how long does materializing take?
+t0 = time.perf_counter(); _ = np.asarray(o[:10]); print("d2h 10 rows:", time.perf_counter()-t0)

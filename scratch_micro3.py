@@ -1,0 +1,62 @@
+"""Honest timing: every measured fn returns a scalar; sync via float()."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+P = 1_277_952
+W = 12
+N_ROWS = 2_000_000
+rng = np.random.default_rng(0)
+perm_np = rng.permutation(P).astype(np.int32)
+vals_np = rng.random((P, W), dtype=np.float32)
+perm = jnp.asarray(perm_np)
+vals = jnp.asarray(vals_np)
+table = jnp.asarray(rng.random((N_ROWS, W), dtype=np.float32))
+idx_flat = jnp.asarray(rng.integers(1, N_ROWS, size=P).astype(np.int32))
+
+
+def timeit(name, fn, *args, n=10):
+    fn_j = jax.jit(fn)
+    float(fn_j(*args))  # compile + first run
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        r = float(fn_j(*args))
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:52s} med={np.median(ts)*1e3:8.2f} ms")
+
+
+timeit("noop scalar (dispatch+sync floor)", lambda v: v[0, 0], vals)
+timeit("take perm [P,12] +sum", lambda v, p: jnp.take(v, p, axis=0).sum(),
+       vals, perm)
+timeit("take table [2M,12] by idx [P] +sum",
+       lambda t, i: jnp.take(t, i, axis=0).sum(), table, idx_flat)
+timeit("sum only [P,12]", lambda v: v.sum(), vals)
+timeit("transpose [12,P]->[P,12] +sum",
+       lambda g: g.T.sum(0)[0], vals.T + 0.0)
+timeit("sort key+12payload +sum",
+       lambda p, v: sum(c.sum() for c in jax.lax.sort(
+           (p,) + tuple(v[:, i] for i in range(W)), num_keys=1)[1:]),
+       perm, vals)
+timeit("sort key only +sum", lambda p: jax.lax.sort(p).sum(), perm)
+timeit("2x sort (plan sorts) +sum",
+       lambda r: sum(x.sum() for x in
+                     (lambda sr, pm: (sr, pm, jax.lax.sort(
+                         (pm, jnp.arange(P, dtype=jnp.int32)), num_keys=1)[1]))(
+                         *jax.lax.sort((r, jnp.arange(P, dtype=jnp.int32)),
+                                       num_keys=1))),
+       idx_flat)
+# gather kernel with scalar output
+from paddlebox_tpu.ops import sorted_spmm as sp
+dims = sp.spmm_dims(P, N_ROWS)
+plan = jax.jit(lambda r: sp.build_plan(r, dims))(idx_flat)
+rows2d, perm2, inv2, ch, tl, fg, fs = plan
+tab_fm = jnp.asarray(rng.random((W, dims.n_kernel), dtype=np.float32))
+timeit("gather kernel +sum",
+       lambda t, r: sp.gather_sorted(t, r, ch, tl, fg, dims).sum(),
+       tab_fm, rows2d)
+pay = jnp.asarray(rng.random((W + 1, dims.p_pad), dtype=np.float32))
+timeit("scatter kernel +sum",
+       lambda p_, r: sp.scatter_add_sorted(p_, r, ch, tl, fs, dims).sum(),
+       pay, rows2d)

@@ -1,0 +1,90 @@
+"""Device-time microbench immune to RPC latency: K dependent iterations
+inside one jit, scalar out; per-op = (t - floor) / K."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+P = 1_277_952
+W = 12
+N_ROWS = 2_000_000
+K = 20
+rng = np.random.default_rng(0)
+perm_np = rng.permutation(P).astype(np.int32)
+perm = jnp.asarray(perm_np)
+vals = jnp.asarray(rng.random((P, W), dtype=np.float32))
+table = jnp.asarray(rng.random((N_ROWS, W), dtype=np.float32))
+idx_flat = jnp.asarray(rng.integers(1, N_ROWS, size=P).astype(np.int32))
+
+FLOOR = None
+
+def timeit(name, body, *args, k=K, n=6):
+    """body(carry_scalar, *args) -> scalar; iterated k times."""
+    @jax.jit
+    def run(*a):
+        def it(i, c):
+            return body(c, *a)
+        return jax.lax.fori_loop(0, k, it, jnp.float32(0))
+    float(run(*args))
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        float(run(*args))
+        ts.append(time.perf_counter() - t0)
+    med = np.median(ts)
+    global FLOOR
+    if FLOOR is None:
+        FLOOR = med
+        print(f"{name:52s} total={med*1e3:8.1f} ms (floor)")
+    else:
+        per = (med - FLOOR) / k
+        print(f"{name:52s} per-op={per*1e3:8.2f} ms")
+
+
+timeit("floor (add only)", lambda c, v: c + v[0, 0], vals)
+timeit("take perm [P,12]",
+       lambda c, v, p: c + jnp.take(v + c, p, axis=0).sum(), vals, perm)
+timeit("take table [2M,12] by [P]",
+       lambda c, t, i: c + jnp.take(t + c, i, axis=0).sum(), table, idx_flat)
+timeit("take table [2M,12] by [P] no-table-dep",
+       lambda c, t, i: c + jnp.take(t, jnp.minimum(i + c.astype(jnp.int32), N_ROWS - 1), axis=0).sum(),
+       table, idx_flat)
+timeit("sum [P,12]", lambda c, v: c + (v + c).sum(), vals)
+timeit("transpose [12,P]->[P,12]",
+       lambda c, g: c + (g + c).T.sum(), vals.T + 0.0)
+timeit("sort key+12 payload",
+       lambda c, p, v: c + sum(x.sum() for x in jax.lax.sort(
+           (p,) + tuple((v + c)[:, i] for i in range(W)), num_keys=1)[1:]),
+       perm, vals)
+timeit("sort key+iota (plan sort)",
+       lambda c, i: c + jax.lax.sort(
+           (jnp.minimum(i + c.astype(jnp.int32), N_ROWS - 1),
+            jnp.arange(P, dtype=jnp.int32)), num_keys=1)[1].sum().astype(jnp.float32),
+       idx_flat)
+
+from paddlebox_tpu.ops import sorted_spmm as sp
+dims = sp.spmm_dims(P, N_ROWS)
+plan = jax.jit(lambda r: sp.build_plan(r, dims))(idx_flat)
+rows2d, perm2, inv2, ch, tl, fg, fs = plan
+tab_fm = jnp.asarray(rng.random((W, dims.n_kernel), dtype=np.float32))
+timeit("gather kernel c512 t2048",
+       lambda c, t, r: c + sp.gather_sorted(t + c, r, ch, tl, fg, dims).sum(),
+       tab_fm, rows2d)
+pay = jnp.asarray(rng.random((W + 1, dims.p_pad), dtype=np.float32))
+timeit("scatter kernel c512 t2048",
+       lambda c, p_, r: c + sp.scatter_add_sorted(p_ + c, r, ch, tl, fs,
+                                                  dims).sum(),
+       pay, rows2d)
+
+dims2 = sp.spmm_dims(P, N_ROWS, chunk=1024, tile=4096)
+plan2 = jax.jit(lambda r: sp.build_plan(r, dims2))(idx_flat)
+rows2d2, _, _, ch2, tl2, fg2, fs2 = plan2
+tab2 = jnp.asarray(rng.random((W, dims2.n_kernel), dtype=np.float32))
+timeit("gather kernel c1024 t4096",
+       lambda c, t, r: c + sp.gather_sorted(t + c, r, ch2, tl2, fg2,
+                                            dims2).sum(), tab2, rows2d2)
+pay2 = jnp.asarray(rng.random((W + 1, dims2.p_pad), dtype=np.float32))
+timeit("scatter kernel c1024 t4096",
+       lambda c, p_, r: c + sp.scatter_add_sorted(p_ + c, r, ch2, tl2, fs2,
+                                                  dims2).sum(), pay2, rows2d2)

@@ -1,0 +1,82 @@
+"""H2D bandwidth, optimizer cost, take width sensitivity."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+rng = np.random.default_rng(0)
+
+# --- H2D bandwidth ---------------------------------------------------------
+for mb in (16, 64, 160):
+    a = rng.integers(0, 2**31, size=(mb * 1024 * 1024 // 4,), dtype=np.int32)
+    d = jax.device_put(a); jax.block_until_ready(d)  # warm path
+    t0 = time.perf_counter()
+    d = jax.device_put(a)
+    jax.block_until_ready(d)
+    # force real completion: read one element back
+    _ = int(d[0])
+    dt = time.perf_counter() - t0
+    print(f"H2D {mb:4d} MB: {dt:6.2f} s  -> {mb/dt:7.1f} MB/s")
+
+# --- optimizer full-table cost --------------------------------------------
+from paddlebox_tpu.ps import optimizer as sparse_opt
+from paddlebox_tpu.ps import embedding
+from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+
+N_ROWS, MF = 2_000_000, 8
+cfg = EmbeddingTableConfig(embedding_dim=MF,
+                           sgd=SparseSGDConfig(mf_create_thresholds=0.0))
+host = {}
+ws = embedding.build_working_set(
+    {"show": rng.random(N_ROWS).astype(np.float32),
+     "click": rng.random(N_ROWS).astype(np.float32),
+     "embed_w": rng.random(N_ROWS).astype(np.float32),
+     "embedx": rng.random((N_ROWS, MF)).astype(np.float32),
+     }, MF) if hasattr(embedding, "build_working_set") else None
+print("ws keys:", None if ws is None else list(ws.keys()))
+
+acc = {
+    "g_show": jnp.asarray(rng.random(N_ROWS, dtype=np.float32)),
+    "g_click": jnp.asarray(rng.random(N_ROWS, dtype=np.float32)),
+    "g_embed": jnp.asarray(rng.random(N_ROWS, dtype=np.float32)),
+    "g_embedx": jnp.asarray(rng.random((N_ROWS, MF), dtype=np.float32)),
+    "slot": jnp.zeros((N_ROWS,), jnp.int32),
+}
+K = 20
+
+@jax.jit
+def opt_loop(ws_in, acc_in):
+    def it(i, w):
+        w2 = sparse_opt.apply_push(w, acc_in, cfg.sgd)
+        return w2
+    w = jax.lax.fori_loop(0, K, it, ws_in)
+    return w["show"].sum()
+
+@jax.jit
+def floor_loop(ws_in):
+    def it(i, c):
+        return c + ws_in["show"][0]
+    return jax.lax.fori_loop(0, K, it, jnp.float32(0))
+
+float(floor_loop(ws))
+t0 = time.perf_counter(); float(floor_loop(ws)); fl = time.perf_counter() - t0
+float(opt_loop(ws, acc))
+t0 = time.perf_counter(); float(opt_loop(ws, acc)); dt = time.perf_counter() - t0
+print(f"apply_push per-op: {(dt-fl)/K*1e3:.2f} ms")
+
+# --- take width sensitivity ------------------------------------------------
+P = 1_277_952
+perm = jnp.asarray(rng.permutation(P).astype(np.int32))
+for w_, dt_ in ((12, jnp.float32), (24, jnp.float32), (6, jnp.float32),
+                (12, jnp.bfloat16)):
+    v = jnp.asarray(rng.random((P, w_), dtype=np.float32)).astype(dt_)
+
+    @jax.jit
+    def tk(v_, p_):
+        def it(i, c):
+            return c + jnp.take(v_ + c.astype(v_.dtype), p_, axis=0
+                                ).sum().astype(jnp.float32)
+        return jax.lax.fori_loop(0, K, it, jnp.float32(0))
+    float(tk(v, perm))
+    t0 = time.perf_counter(); float(tk(v, perm)); dt = time.perf_counter() - t0
+    print(f"take [P,{w_}] {dt_.__name__}: {(dt-fl)/K*1e3:.2f} ms")

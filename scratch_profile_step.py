@@ -1,0 +1,110 @@
+"""Profile the mxu step phases at driver geometry on the real chip."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+S, L, B = 26, 3, 16384
+N_ROWS = 2_000_000
+MF = 8
+P = S * L * B
+
+rng = np.random.default_rng(0)
+idx_np = rng.integers(1, N_ROWS, size=(S, L, B)).astype(np.int32)
+
+from paddlebox_tpu.ps import mxu_path
+from paddlebox_tpu.ops import sorted_spmm as sp
+
+dims = mxu_path.make_dims(P, N_ROWS)
+print("dims:", dims)
+
+idx = jnp.asarray(idx_np)
+
+def timeit(name, fn, *args, n=20, **kw):
+    fn_j = jax.jit(fn, **kw)
+    out = fn_j(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn_j(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:34s} {dt*1e3:8.2f} ms")
+    return out, dt
+
+# 1. plan build (sort + worklist)
+plan, t_plan = timeit("build_plan", lambda i: mxu_path.build_plan(i, dims), idx)
+rows2d, perm, inv_perm, ch, tl, fg, fs = plan
+
+# 2. pull table build
+ws = {
+    "show": jnp.asarray(rng.random(N_ROWS, dtype=np.float32)),
+    "click": jnp.asarray(rng.random(N_ROWS, dtype=np.float32)),
+    "embed_w": jnp.asarray(rng.random(N_ROWS, dtype=np.float32)),
+    "mf": jnp.asarray(rng.random((N_ROWS, MF), dtype=np.float32)),
+    "mf_size": jnp.full((N_ROWS,), MF, jnp.int32),
+}
+tab, t_tab = timeit("pull_table build", lambda w: mxu_path._pull_table(w, dims), ws)
+
+# 3. gather kernel
+g, t_g = timeit("gather_sorted kernel",
+                lambda t, r: sp.gather_sorted(t, r, ch, tl, fg, dims), tab, rows2d)
+
+# 4. inv_perm take (sorted -> canonical) [p, 12]
+v, t_take = timeit("take(inv_perm) [p,12]",
+                   lambda g_, ip: jnp.take(g_.T[:dims.p], ip, axis=0), g, inv_perm)
+
+# 4b. the whole pull_pool_cvm fused
+pooled, t_pull = timeit("pull_pool_cvm (fused)",
+                        lambda w, r, ip: mxu_path.pull_pool_cvm(
+                            w, (r, perm, ip, ch, tl, fg, fs), dims, (S, L, B), True),
+                        ws, rows2d, inv_perm)
+
+# 5. payload build + perm take + scatter
+payload = jnp.asarray(rng.random((dims.p, MF + 5), dtype=np.float32))
+srt, t_ptake = timeit("take(perm) [p,13]",
+                      lambda p_, pm: jnp.take(p_, pm, axis=0), payload, perm)
+srt_pad = jnp.concatenate([srt, jnp.zeros((dims.p_pad - dims.p, MF + 5), jnp.float32)])
+delta, t_s = timeit("scatter_add_sorted kernel",
+                    lambda s_, r: sp.scatter_add_sorted(s_.T, r, ch, tl, fs, dims),
+                    srt_pad, rows2d)
+
+# 6. optimizer full-table
+from paddlebox_tpu.ps import optimizer as sparse_opt
+from paddlebox_tpu.config import SparseSGDConfig
+cfg = SparseSGDConfig(mf_create_thresholds=0.0)
+ws2 = dict(ws)
+ws2["g2sum"] = jnp.zeros((N_ROWS,), jnp.float32)
+ws2["mf_g2sum"] = jnp.zeros((N_ROWS,), jnp.float32)
+acc = {
+    "g_show": jnp.asarray(rng.random(N_ROWS, dtype=np.float32)),
+    "g_click": jnp.asarray(rng.random(N_ROWS, dtype=np.float32)),
+    "g_embed": jnp.asarray(rng.random(N_ROWS, dtype=np.float32)),
+    "g_embedx": jnp.asarray(rng.random((N_ROWS, MF), dtype=np.float32)),
+    "slot": jnp.zeros((N_ROWS,), jnp.int32),
+}
+try:
+    opt_out, t_opt = timeit("apply_push optimizer",
+                            lambda w, a: sparse_opt.apply_push(w, a, cfg), ws2, acc)
+except Exception as e:
+    print("optimizer profile failed:", e)
+
+# 7. dense half: DeepFM fwd/bwd
+from paddlebox_tpu.models.deepfm import DeepFM
+import optax
+model = DeepFM(num_slots=S, emb_width=3 + MF, dense_dim=13, hidden=(400, 400, 400))
+params = model.init(jax.random.PRNGKey(0))
+dense = jnp.asarray(rng.random((B, 13), dtype=np.float32))
+labels = jnp.asarray(rng.integers(0, 2, B).astype(np.float32))
+
+def dense_fwd_bwd(p, pooled_in):
+    def loss_fn(p_, x):
+        logits = model.apply(p_, x.reshape(B, -1), dense)
+        return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+    return jax.value_and_grad(loss_fn, argnums=(0, 1))(p, pooled_in)
+
+_, t_dense = timeit("dense fwd/bwd (DeepFM 400x3)", dense_fwd_bwd, params, pooled)
+
+print()
+tot = t_plan + t_tab + t_g + t_take + t_ptake + t_s + t_dense
+print(f"sum of pieces (no opt): {tot*1e3:.1f} ms -> {B/tot:,.0f} ex/s")
