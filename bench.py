@@ -183,9 +183,8 @@ def _profile_step_phases(trainer, feed, k=8):
     n, s, l, b = feed.data["indices"].shape
     dims = mxu_path.make_dims(s * l * b, n_rows)
     interpret = jax.default_backend() == "cpu"
-    p0 = jax.tree.map(lambda a: a[0], feed.plans)
-    plan = (p0["rows2d"], p0["perm"], p0["inv_perm"], p0["ch"], p0["tl"],
-            p0["fg"], p0["fs"], p0["first_occ"])
+    from paddlebox_tpu.data.pass_feed import plan_tuple
+    plan = plan_tuple(jax.tree.map(lambda a: a[0], feed.plans))
     bt = jax.tree.map(lambda a: a[0], feed.data)
     half = trainer._pooled_dense_half()
     slot_ids = jnp.asarray(trainer.slot_ids)

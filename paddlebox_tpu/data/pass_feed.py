@@ -232,3 +232,10 @@ def slice_batch(tree, i):
     """Batch i of a stacked pytree (XLA dynamic-slice inside jit)."""
     return jax.tree.map(
         lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False), tree)
+
+
+def plan_tuple(p: Dict[str, jnp.ndarray]):
+    """Plans dict (one batch) → the positional tuple build_plan returns —
+    single source of the field order for every consumer."""
+    return (p["rows2d"], p["perm"], p["inv_perm"], p["ch"], p["tl"],
+            p["fg"], p["fs"], p["first_occ"])

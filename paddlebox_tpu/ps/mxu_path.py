@@ -57,6 +57,61 @@ def _pull_table(ws: Dict[str, jnp.ndarray], dims: sp.SpmmDims) -> jnp.ndarray:
     return tab
 
 
+def pool_cvm_values(v: jnp.ndarray, use_cvm: bool = True) -> jnp.ndarray:
+    """Canonical per-occurrence pull values [S, L, B, 3+D+1] (last col =
+    mf_size) → pooled [B, S, 3+D].  Shared by the single-chip path and the
+    shard_map'd multi-chip step (which pools its LOCAL batch shard)."""
+    d = v.shape[-1] - 4
+    created = (v[..., 3 + d:] > 0).astype(v.dtype)         # [S,L,B,1]
+    show = jnp.sum(v[..., 0], axis=1)                      # [S, B]
+    click = jnp.sum(v[..., 1], axis=1)
+    w = jnp.sum(v[..., 2], axis=1)
+    mf = jnp.sum(v[..., 3:3 + d] * created, axis=1)        # [S, B, D]
+    if use_cvm:
+        show_t = jnp.log(show + 1.0)
+        click_t = jnp.log(click + 1.0) - show_t
+    else:
+        show_t, click_t = show, click
+    head = jnp.stack([show_t, click_t, w], axis=-1)        # [S, B, 3]
+    pooled = jnp.concatenate([head, mf], axis=-1)
+    return jnp.transpose(pooled, (1, 0, 2))                # [B, S, E]
+
+
+def push_payload(d_pooled: jnp.ndarray, ins_cvm: jnp.ndarray,
+                 slot_ids: jnp.ndarray,
+                 shape_slb: Tuple[int, int, int]) -> jnp.ndarray:
+    """Canonical per-occurrence push payload [S, L, B, D+4]:
+    g_show, g_click, g_embed, g_mf x D, slot (reference push semantics —
+    cols 0,1 of d_pooled are ignored, replaced by the instance cvm,
+    box_wrapper_impl.h:373)."""
+    s, l, b = shape_slb
+    d = d_pooled.shape[-1] - 3
+    g_show = jnp.broadcast_to(ins_cvm[None, None, :, 0], (s, l, b))
+    g_click = jnp.broadcast_to(ins_cvm[None, None, :, 1], (s, l, b))
+    d_w = jnp.transpose(d_pooled[:, :, 2], (1, 0))         # [S, B]
+    g_embed = jnp.broadcast_to(d_w[:, None, :], (s, l, b))
+    d_mf = jnp.transpose(d_pooled[:, :, 3:], (1, 0, 2))    # [S, B, D]
+    g_mf = jnp.broadcast_to(d_mf[:, None], (s, l, b, d))
+    slot_col = jnp.broadcast_to(
+        slot_ids.astype(jnp.float32)[:, None, None], (s, l, b))
+    return jnp.concatenate(
+        [jnp.stack([g_show, g_click, g_embed], axis=-1), g_mf,
+         slot_col[..., None]], axis=-1)                    # [S,L,B,D+4]
+
+
+def acc_from_delta(delta: jnp.ndarray, n: int) -> Dict[str, jnp.ndarray]:
+    """Merged per-row accumulators for ps.optimizer.apply_push from the
+    scatter output [D+4, >=n] (slot column already first-occurrence-exact)."""
+    d = delta.shape[0] - 4
+    return {
+        "g_show": delta[0, :n],
+        "g_click": delta[1, :n],
+        "g_embed": delta[2, :n],
+        "g_embedx": delta[3:3 + d, :n].T,
+        "slot": jnp.rint(delta[d + 3, :n]).astype(jnp.int32),
+    }
+
+
 def pull_pool_cvm(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
                   shape_slb: Tuple[int, int, int], use_cvm: bool = True,
                   interpret: bool = False) -> jnp.ndarray:
@@ -73,19 +128,7 @@ def pull_pool_cvm(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
                          interpret=interpret)              # [12, p_pad]
     v = jnp.take(g.T[:dims.p], inv_perm, axis=0)           # canonical [p,12]
     v = v.reshape(s, l, b, 3 + d + 1)
-    created = (v[..., 3 + d:] > 0).astype(v.dtype)         # [S,L,B,1]
-    show = jnp.sum(v[..., 0], axis=1)                      # [S, B]
-    click = jnp.sum(v[..., 1], axis=1)
-    w = jnp.sum(v[..., 2], axis=1)
-    mf = jnp.sum(v[..., 3:3 + d] * created, axis=1)        # [S, B, D]
-    if use_cvm:
-        show_t = jnp.log(show + 1.0)
-        click_t = jnp.log(click + 1.0) - show_t
-    else:
-        show_t, click_t = show, click
-    head = jnp.stack([show_t, click_t, w], axis=-1)        # [S, B, 3]
-    pooled = jnp.concatenate([head, mf], axis=-1)
-    return jnp.transpose(pooled, (1, 0, 2))                # [B, S, E]
+    return pool_cvm_values(v, use_cvm)
 
 
 def push_and_update(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
@@ -104,19 +147,7 @@ def push_and_update(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
     n = ws["show"].shape[0]
     rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
 
-    # canonical per-occurrence payload [S, L, B, D+4]:
-    #   g_show, g_click, g_embed, g_mf x D, slot
-    g_show = jnp.broadcast_to(ins_cvm[None, None, :, 0], (s, l, b))
-    g_click = jnp.broadcast_to(ins_cvm[None, None, :, 1], (s, l, b))
-    d_w = jnp.transpose(d_pooled[:, :, 2], (1, 0))         # [S, B]
-    g_embed = jnp.broadcast_to(d_w[:, None, :], (s, l, b))
-    d_mf = jnp.transpose(d_pooled[:, :, 3:], (1, 0, 2))    # [S, B, D]
-    g_mf = jnp.broadcast_to(d_mf[:, None], (s, l, b, d))
-    slot_col = jnp.broadcast_to(
-        slot_ids.astype(jnp.float32)[:, None, None], (s, l, b))
-    payload = jnp.concatenate(
-        [jnp.stack([g_show, g_click, g_embed], axis=-1), g_mf,
-         slot_col[..., None]], axis=-1)                    # [S,L,B,D+4]
+    payload = push_payload(d_pooled, ins_cvm, slot_ids, (s, l, b))
     flat = payload.reshape(dims.p, d + 4)
     srt = jnp.take(flat, perm, axis=0)                     # sorted domain
     srt = jnp.concatenate(
@@ -129,12 +160,4 @@ def push_and_update(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
     srt = srt.at[:, d + 3].mul(first_occ)
     delta = sp.scatter_add_sorted(srt.T, rows2d, ch, tl, fs, dims,
                                   interpret=interpret)     # [D+4, n_kernel]
-
-    acc = {
-        "g_show": delta[0, :n],
-        "g_click": delta[1, :n],
-        "g_embed": delta[2, :n],
-        "g_embedx": delta[3:3 + d, :n].T,
-        "slot": jnp.rint(delta[d + 3, :n]).astype(jnp.int32),
-    }
-    return sparse_opt.apply_push(ws, acc, cfg)
+    return sparse_opt.apply_push(ws, acc_from_delta(delta, n), cfg)

@@ -115,11 +115,17 @@ def pull_rows_sharded_mxu(table_fm_local: jnp.ndarray,
 
 def push_rows_sharded_mxu(idx_local: jnp.ndarray,
                           payload_local: jnp.ndarray, rows_loc: int,
-                          axis: str, interpret: bool = False) -> jnp.ndarray:
+                          axis: str, interpret: bool = False,
+                          first_only_col: int = -1) -> jnp.ndarray:
     """Inside shard_map.  payload_local: [W, P_loc] per-occurrence push
     values.  → merged per-row accumulators [W, rows_loc] for this device's
     block (feed to the local optimizer, ≙ gather_one_node_grad + local
-    merge, heter_comm_inl.h:2027)."""
+    merge, heter_comm_inl.h:2027).
+
+    first_only_col >= 0: that payload row keeps only each table row's FIRST
+    occurrence before the merge (exact carry of e.g. the slot id instead of
+    a sum — each row is owned by exactly one device, so its first gathered
+    occurrence is the global first)."""
     from paddlebox_tpu.ops import sorted_spmm as sp
     dims, plan = _local_plan(idx_local, rows_loc, axis)
     rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
@@ -128,6 +134,8 @@ def push_rows_sharded_mxu(idx_local: jnp.ndarray,
     srt = jnp.concatenate(
         [srt, jnp.zeros((pay_all.shape[0], dims.p_pad - dims.p),
                         pay_all.dtype)], axis=1)
+    if first_only_col >= 0:
+        srt = srt.at[first_only_col, :].mul(first_occ)
     delta = sp.scatter_add_sorted(srt, rows2d, ch, tl, fs, dims,
                                   interpret=interpret)
     return delta[:, :rows_loc]

@@ -29,7 +29,8 @@ import optax
 from paddlebox_tpu.config import DataFeedConfig, TrainerConfig
 from paddlebox_tpu.data.batch_pack import BatchPacker, PackedBatch
 from paddlebox_tpu.data.dataset import SlotDataset
-from paddlebox_tpu.data.pass_feed import PackedPassFeed, slice_batch
+from paddlebox_tpu.data.pass_feed import (PackedPassFeed, plan_tuple,
+                                          slice_batch)
 from paddlebox_tpu.metrics.auc import (AucCalculator, accumulate_auc,
                                        make_auc_state)
 from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
@@ -116,16 +117,32 @@ class SparseTrainer:
                 # numerically-exact reference step — honor it
                 path = "reference"
             elif not has_ex and self.topology is None:
-                # mxu path composes with every optimizer rule, but its
-                # Pallas kernels are single-chip (GSPMD cannot partition
-                # them); sharded meshes take the partitionable paths —
-                # the shard_map variants live in ps/sharded_embedding.py
                 path = "mxu"
+            elif not has_ex and self._mxu_shardable():
+                # explicit HeterComm-style exchange: row-sharded table,
+                # all_gather(ids) + per-device sorted-SpMM kernels +
+                # psum_scatter(values) inside shard_map
+                # (≙ heter_comm_inl.h:1296,1730 sharded pull/push in the
+                # hot loop)
+                path = "mxu_sharded"
             elif is_adagrad:
                 path = "fast"
             else:
                 path = "reference"
         return path
+
+    def _mxu_shardable(self) -> bool:
+        """mxu_sharded wants the HeterComm-symmetric layout: every device
+        holds a batch shard AND a table shard, i.e. a pure dp×sharding
+        mesh (pp/mp/sp/ep all 1) with evenly divisible batch and table."""
+        if self.topology is None:
+            return False
+        t = self.topology
+        if any(t.axis_size(a) != 1 for a in ("pp", "mp", "sp", "ep")):
+            return False
+        n_dev = t.axis_size("dp") * t.axis_size("sharding")
+        return (self.batch_size % n_dev == 0
+                and self.engine.ws["show"].shape[0] % n_dev == 0)
 
     def _validate_path(self, path: str) -> None:
         """Reject configs a path cannot honor — both the per-batch and the
@@ -138,6 +155,16 @@ class SparseTrainer:
                 raise ValueError(
                     "sparse_path='mxu' does not support extended (mf_ex) "
                     "tables — use 'fast' or 'reference'")
+        elif path == "mxu_sharded":
+            if has_ex:
+                raise ValueError(
+                    "sparse_path='mxu_sharded' does not support extended "
+                    "(mf_ex) tables — use 'fast' or 'reference'")
+            if not self._mxu_shardable():
+                raise ValueError(
+                    "sparse_path='mxu_sharded' needs a topology with a "
+                    "pure dp×sharding mesh (pp/mp/sp/ep == 1) and batch/"
+                    "table sizes divisible by the device count")
         elif path == "fast":
             if not is_adagrad:
                 raise ValueError(
@@ -146,8 +173,8 @@ class SparseTrainer:
         elif path == "reference":
             if self.async_dense is not None:
                 raise ValueError(
-                    "dense_sync_mode='async_table' requires the mxu or "
-                    "fast sparse path")
+                    "dense_sync_mode='async_table' requires the mxu, "
+                    "mxu_sharded or fast sparse path")
         else:
             raise ValueError(f"unknown sparse_path {path!r}")
 
@@ -253,6 +280,77 @@ class SparseTrainer:
                 ws = mxu_path.push_and_update(ws, plan, dims, idx_slb,
                                               d_pooled, ins_cvm, slot_ids,
                                               sgd_cfg, interpret=interpret)
+                out = (ws, params, opt_state, auc_state, loss, preds)
+                return out + ((d_params,) if async_dense else ())
+            return core
+
+        if path == "mxu_sharded":
+            # the multi-chip hot loop as explicit HeterComm-equivalent
+            # exchange (≙ heter_comm_inl.h:1296 pull_merge_sparse, :1730
+            # push merge, :2027 gather_one_node_grad): table row-sharded in
+            # contiguous blocks over every device, batch dp-sharded; pull =
+            # all_gather(ids) + local sorted-SpMM gather + psum_scatter;
+            # push = all_gather(ids, payload) + local sorted-SpMM merge;
+            # optimizer runs GSPMD-elementwise on the row-sharded table.
+            from paddlebox_tpu.ps import mxu_path
+            from paddlebox_tpu.ps import sharded_embedding as se
+            from jax.sharding import PartitionSpec as P
+            interpret = jax.default_backend() == "cpu"
+            half = self._pooled_dense_half()
+            mesh = self.topology.mesh
+            axes = ("dp", "sharding")
+            n_dev = (self.topology.axis_size("dp")
+                     * self.topology.axis_size("sharding"))
+
+            def core(ws, params, opt_state, auc_state, idx_slb, lengths,
+                     dense, labels, valid, plan):
+                s, l, b = idx_slb.shape
+                d = ws["mf"].shape[1]
+                n_rows = ws["show"].shape[0]
+                rows_loc = n_rows // n_dev
+                idx_slb = jnp.where(jnp.arange(l)[None, :, None]
+                                    < lengths[:, None, :], idx_slb, 0)
+
+                def pull_local(show, click, embed_w, mf, mf_size, idx_loc):
+                    tab = jnp.concatenate(
+                        [show[None], click[None], embed_w[None], mf.T,
+                         mf_size.astype(jnp.float32)[None]], axis=0)
+                    vals = se.pull_rows_sharded_mxu(
+                        tab, idx_loc.reshape(-1), axes, interpret=interpret)
+                    b_loc = idx_loc.shape[2]
+                    return vals.T.reshape(s, l, b_loc, 3 + d + 1)
+
+                v = jax.shard_map(
+                    pull_local, mesh=mesh,
+                    in_specs=(P(axes), P(axes), P(axes), P(axes, None),
+                              P(axes), P(None, None, axes)),
+                    out_specs=P(None, None, axes, None),
+                    check_vma=False)(
+                    ws["show"], ws["click"], ws["embed_w"], ws["mf"],
+                    ws["mf_size"], idx_slb)
+                pooled = jax.lax.stop_gradient(
+                    mxu_path.pool_cvm_values(v, use_cvm))
+                (params, opt_state, auc_state, loss, preds, d_pooled,
+                 d_params) = half(params, opt_state, auc_state, pooled,
+                                  dense, labels, valid)
+                ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
+                payload = mxu_path.push_payload(d_pooled, ins_cvm, slot_ids,
+                                                (s, l, b))   # [S,L,B,D+4]
+
+                def push_local(idx_loc, pay_loc):
+                    p_loc = idx_loc.size
+                    pay_fm = pay_loc.reshape(p_loc, d + 4).T  # [D+4, P_loc]
+                    return se.push_rows_sharded_mxu(
+                        idx_loc.reshape(-1), pay_fm, rows_loc, axes,
+                        interpret=interpret, first_only_col=d + 3)
+
+                delta = jax.shard_map(
+                    push_local, mesh=mesh,
+                    in_specs=(P(None, None, axes), P(None, None, axes, None)),
+                    out_specs=P(None, axes),
+                    check_vma=False)(idx_slb, payload)        # [D+4, n_rows]
+                acc = mxu_path.acc_from_delta(delta, n_rows)
+                ws = sparse_opt.apply_push(ws, acc, sgd_cfg)
                 out = (ws, params, opt_state, auc_state, loss, preds)
                 return out + ((d_params,) if async_dense else ())
             return core
@@ -378,11 +476,7 @@ class SparseTrainer:
 
         def step(ws, params, opt_state, auc_state, i, data, plans):
             bt = slice_batch(data, i)
-            plan = None
-            if with_plans:
-                p = slice_batch(plans, i)
-                plan = (p["rows2d"], p["perm"], p["inv_perm"], p["ch"],
-                        p["tl"], p["fg"], p["fs"], p["first_occ"])
+            plan = plan_tuple(slice_batch(plans, i)) if with_plans else None
             return core(ws, params, opt_state, auc_state, bt["indices"],
                         bt["lengths"], bt["dense"], bt["labels"],
                         bt["valid"], plan)
