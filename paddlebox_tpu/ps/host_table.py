@@ -188,6 +188,14 @@ class ShardedHostTable:
             with np.load(f) as z:
                 with shard.lock:
                     shard.keys = z["keys"]
-                    shard.soa = {name: z[name] for name in shard.soa}
+                    n = len(shard.keys)
+                    # checkpoints from a different optimizer config may
+                    # lack some state fields (e.g. adam moments when the
+                    # save ran under adagrad) — zero-init those instead of
+                    # KeyErroring, matching the accessor's fresh-row init
+                    shard.soa = {
+                        name: (z[name] if name in z.files else
+                               np.zeros((n,) + tmpl.shape[1:], tmpl.dtype))
+                        for name, tmpl in shard.soa.items()}
             loaded += shard.size
         return loaded

@@ -135,8 +135,12 @@ class BoxPSEngine:
         # thread: concurrent device dispatch from two python threads can
         # deadlock single-stream runtimes
         def run():
-            self._next = self._build_host(uniq)
+            try:
+                self._next = self._build_host(uniq)
+            except BaseException as e:  # re-raised in begin_pass, not lost
+                self._build_error = e
 
+        self._build_error = None
         self._build_thread = threading.Thread(target=run, daemon=True)
         self._build_thread.start()
 
@@ -150,6 +154,12 @@ class BoxPSEngine:
     def begin_pass(self) -> None:
         if self._build_thread is not None or self._next is not None:
             self.wait_feed_pass_done()
+            err = getattr(self, "_build_error", None)
+            if err is not None:
+                self._build_error = None
+                raise RuntimeError(
+                    "async working-set build failed (end_feed_pass "
+                    "background thread)") from err
             assert self._next is not None
             self.mapper, self.num_keys, host_rows = self._next
             self.ws = self._upload(host_rows)

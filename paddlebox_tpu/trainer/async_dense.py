@@ -39,6 +39,7 @@ class AsyncDenseTable:
         self._t = 0
         self._pushed = 0
         self._applied = 0
+        self._error: Optional[BaseException] = None
         self._ch: Channel = Channel(capacity=queue_capacity)
         self._thread = threading.Thread(target=self._update_loop,
                                         daemon=True)
@@ -60,6 +61,12 @@ class AsyncDenseTable:
     def _update_loop(self) -> None:
         """≙ AsyncUpdate/ThreadUpdate (boxps_worker.cc:260-330): drain the
         channel, merge whatever is queued, apply one adam step."""
+        try:
+            self._update_loop_inner()
+        except BaseException as e:  # surface in drain(), don't die silently
+            self._error = e
+
+    def _update_loop_inner(self) -> None:
         while True:
             try:
                 g = self._ch.get()
@@ -84,8 +91,18 @@ class AsyncDenseTable:
     # ------------------------------------------------------------------
     def drain(self) -> None:
         """Block until every pushed batch has been *applied* (an empty
-        channel alone can still have one item mid-apply in the thread)."""
+        channel alone can still have one item mid-apply in the thread).
+        Raises instead of spinning forever if the update thread died."""
         while self._applied < self._pushed:
+            if self._error is not None:
+                raise RuntimeError(
+                    "async dense update thread failed with "
+                    f"{self._pushed - self._applied} pushes pending"
+                ) from self._error
+            if not self._thread.is_alive():
+                raise RuntimeError(
+                    "async dense update thread exited with "
+                    f"{self._pushed - self._applied} pushes pending")
             threading.Event().wait(0.002)
 
     def finalize(self):
