@@ -191,11 +191,23 @@ class ShardedHostTable:
                     n = len(shard.keys)
                     # checkpoints from a different optimizer config may
                     # lack some state fields (e.g. adam moments when the
-                    # save ran under adagrad) — zero-init those instead of
-                    # KeyErroring, matching the accessor's fresh-row init
+                    # save ran under adagrad) — init those like fresh rows
+                    # instead of KeyErroring: moments/g2sums start at 0,
+                    # beta-power trackers at the decay rates (the adam
+                    # creation init, ≙ optimizer.cuh.h:436-441)
+                    sgd = self.config.sgd
+                    fresh = {"_b1p": sgd.beta1_decay_rate,
+                             "_b2p": sgd.beta2_decay_rate}
+
+                    def init_missing(name, tmpl):
+                        fill = next((v for suf, v in fresh.items()
+                                     if name.endswith(suf)), 0.0)
+                        return np.full((n,) + tmpl.shape[1:], fill,
+                                       tmpl.dtype)
+
                     shard.soa = {
                         name: (z[name] if name in z.files else
-                               np.zeros((n,) + tmpl.shape[1:], tmpl.dtype))
+                               init_missing(name, tmpl))
                         for name, tmpl in shard.soa.items()}
             loaded += shard.size
         return loaded

@@ -145,21 +145,24 @@ class BoxPSEngine:
         self._build_thread.start()
 
     def wait_feed_pass_done(self) -> None:
-        """≙ BoxHelper::WaitFeedPassDone (box_wrapper.h:1156)."""
+        """≙ BoxHelper::WaitFeedPassDone (box_wrapper.h:1156).  Raises if
+        the background build failed — whichever of this or begin_pass runs
+        first surfaces the error; a stale previous working set must never
+        silently train in place of the failed pass."""
         if self._build_thread is not None:
             self._build_thread.join()
             self._build_thread = None
+        err = getattr(self, "_build_error", None)
+        if err is not None:
+            self._build_error = None
+            raise RuntimeError(
+                "async working-set build failed (end_feed_pass "
+                "background thread)") from err
 
     # -- train pass ----------------------------------------------------------
     def begin_pass(self) -> None:
         if self._build_thread is not None or self._next is not None:
-            self.wait_feed_pass_done()
-            err = getattr(self, "_build_error", None)
-            if err is not None:
-                self._build_error = None
-                raise RuntimeError(
-                    "async working-set build failed (end_feed_pass "
-                    "background thread)") from err
+            self.wait_feed_pass_done()   # raises if the async build failed
             assert self._next is not None
             self.mapper, self.num_keys, host_rows = self._next
             self.ws = self._upload(host_rows)

@@ -3,35 +3,46 @@
 ≙ PSCORE's brpc server/client (ps/service/brpc_ps_server.{h,cc},
 brpc_ps_client.{h,cc}): push/pull sparse & dense against tables sharded by
 ``key % shard_num``, plus save/load/shrink/barrier control verbs.  The
-TPU rebuild keeps the same wire verbs over length-prefixed TCP messages
-(zero-egress pods: no brpc/grpc dependency) — trainers on other hosts pull
-pass working sets from, and flush them to, this service instead of their
-local DRAM (the multi-host BuildPull path, ps_gpu_wrapper.cc:337-419,
-including the retry-then-fail discipline :388-419).
+TPU rebuild keeps the same wire verbs over length-prefixed TCP frames in
+the typed binary codec (ps/wire.py — dtype/shape headers + raw buffers,
+like sendrecv.proto's VariableMessage; NO pickle touches network bytes).
+Several named tables ride one service (≙ brpc's table_id-routed cmds /
+the_one_ps multi-table deployment); trainers on other hosts pull pass
+working sets from, and flush them to, this service instead of their local
+DRAM (the multi-host BuildPull path, ps_gpu_wrapper.cc:337-419, including
+the retry-then-fail discipline :388-419).
 """
 
 from __future__ import annotations
 
-import pickle
 import socket
 import socketserver
 import struct
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional, Tuple, Union
 
 import numpy as np
 
 from paddlebox_tpu.config import EmbeddingTableConfig
+from paddlebox_tpu.ps import wire
 from paddlebox_tpu.ps.host_table import ShardedHostTable
 
+DEFAULT_TABLE = "embedding"
 
-def _send(sock, obj) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+def _send(sock, msg: Dict) -> None:
+    payload = wire.encode(msg)
+    if len(payload) > wire.MAX_FRAME:
+        # non-retryable by construction (RuntimeError, not ConnectionError):
+        # the peer would reject it anyway — fail once with the real reason
+        raise RuntimeError(
+            f"frame of {len(payload)} bytes exceeds wire cap "
+            f"{wire.MAX_FRAME} — split the request (fewer keys per call)")
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
-def _recv(sock):
+def _recv(sock) -> Dict:
     head = b""
     while len(head) < 8:
         chunk = sock.recv(8 - len(head))
@@ -39,23 +50,30 @@ def _recv(sock):
             raise ConnectionError("peer closed")
         head += chunk
     (length,) = struct.unpack("<Q", head)
+    if length > wire.MAX_FRAME:
+        raise ConnectionError(f"oversized frame ({length} bytes)")
     buf = bytearray()
     while len(buf) < length:
         chunk = sock.recv(min(1 << 20, length - len(buf)))
         if not chunk:
             raise ConnectionError("peer closed")
         buf.extend(chunk)
-    return pickle.loads(bytes(buf))
+    return wire.decode(bytes(buf))
 
 
 class PSServer:
-    """Hosts one ShardedHostTable + a dense blob store behind TCP verbs:
+    """Hosts named ShardedHostTables + a dense blob store behind TCP verbs:
     pull_sparse/push_sparse/pull_dense/push_dense/save/load/shrink/
-    end_day/size/barrier (the BrpcPsService cmd surface)."""
+    end_day/size/barrier/list_tables (the BrpcPsService cmd surface with
+    table-name routing ≙ table_id)."""
 
-    def __init__(self, table: ShardedHostTable, host: str = "127.0.0.1",
-                 port: int = 0):
-        self.table = table
+    def __init__(self, table: Union[ShardedHostTable,
+                                    Dict[str, ShardedHostTable]],
+                 host: str = "127.0.0.1", port: int = 0):
+        if isinstance(table, dict):
+            self.tables: Dict[str, ShardedHostTable] = dict(table)
+        else:
+            self.tables = {DEFAULT_TABLE: table}
         self.dense: Dict[str, np.ndarray] = {}
         self._dense_lock = threading.Lock()
         self._barrier_count = 0
@@ -68,7 +86,9 @@ class PSServer:
                 while True:
                     try:
                         req = _recv(self.request)
-                    except (ConnectionError, OSError):
+                    except (ConnectionError, OSError, wire.DecodeError):
+                        # malformed frame → stream sync is gone; drop the
+                        # connection (client reconnects + retries)
                         return
                     try:
                         resp = outer._dispatch(req)
@@ -84,13 +104,26 @@ class PSServer:
                                         daemon=True)
         self._thread.start()
 
+    @property
+    def table(self) -> ShardedHostTable:
+        """Back-compat single-table accessor (the default table)."""
+        return self.tables[DEFAULT_TABLE]
+
+    def _table(self, req: Dict) -> ShardedHostTable:
+        name = req.get("table") or DEFAULT_TABLE
+        t = self.tables.get(name)
+        if t is None:
+            raise KeyError(f"unknown table {name!r} "
+                           f"(have {sorted(self.tables)})")
+        return t
+
     def _dispatch(self, req: Dict) -> Dict:
         cmd = req["cmd"]
         if cmd == "pull_sparse":
-            rows = self.table.bulk_pull(req["keys"])
+            rows = self._table(req).bulk_pull(req["keys"])
             return {"ok": True, "rows": rows}
         if cmd == "push_sparse":
-            self.table.bulk_write(req["keys"], req["rows"])
+            self._table(req).bulk_write(req["keys"], req["rows"])
             return {"ok": True}
         if cmd == "pull_dense":
             with self._dense_lock:
@@ -105,17 +138,20 @@ class PSServer:
                     self.dense[req["name"]] = req["value"]
             return {"ok": True}
         if cmd == "save":
-            n = self.table.save(req["path"], req.get("mode", "all"))
+            n = self._table(req).save(req["path"], req.get("mode", "all"))
             return {"ok": True, "saved": n}
         if cmd == "load":
-            return {"ok": True, "loaded": self.table.load(req["path"])}
+            return {"ok": True, "loaded": self._table(req).load(req["path"])}
         if cmd == "shrink":
-            return {"ok": True, "removed": self.table.shrink()}
+            return {"ok": True, "removed": self._table(req).shrink()}
         if cmd == "end_day":
-            self.table.end_day()
+            self._table(req).end_day()
             return {"ok": True}
         if cmd == "size":
-            return {"ok": True, "size": self.table.size()}
+            return {"ok": True, "size": self._table(req).size()}
+        if cmd == "list_tables":
+            return {"ok": True,
+                    "tables": {n: t.size() for n, t in self.tables.items()}}
         if cmd == "barrier":
             world = req["world"]
             with self._barrier_cv:
@@ -126,9 +162,16 @@ class PSServer:
                     self._barrier_gen += 1
                     self._barrier_cv.notify_all()
                 else:
-                    while self._barrier_gen == gen:
-                        if not self._barrier_cv.wait(timeout=60):
-                            raise TimeoutError("ps barrier timeout")
+                    try:
+                        while self._barrier_gen == gen:
+                            if not self._barrier_cv.wait(timeout=60):
+                                raise TimeoutError("ps barrier timeout")
+                    except TimeoutError:
+                        # roll back this waiter's arrival or every later
+                        # barrier releases one participant short
+                        if self._barrier_gen == gen:
+                            self._barrier_count -= 1
+                        raise
             return {"ok": True}
         return {"ok": False, "error": f"unknown cmd {cmd}"}
 
@@ -174,34 +217,44 @@ class PSClient:
                 time.sleep(self.retry_sleep)
         raise ConnectionError(f"ps unreachable after retries: {last_err}")
 
-    # -- verbs --------------------------------------------------------------
-    def pull_sparse(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
-        return self._call({"cmd": "pull_sparse", "keys": keys})["rows"]
+    # -- verbs (table=None → the default table) -----------------------------
+    def pull_sparse(self, keys: np.ndarray,
+                    table: Optional[str] = None) -> Dict[str, np.ndarray]:
+        return self._call({"cmd": "pull_sparse", "keys": np.asarray(keys),
+                           "table": table})["rows"]
 
-    def push_sparse(self, keys: np.ndarray, rows: Dict[str, np.ndarray]):
-        self._call({"cmd": "push_sparse", "keys": keys, "rows": rows})
+    def push_sparse(self, keys: np.ndarray, rows: Dict[str, np.ndarray],
+                    table: Optional[str] = None):
+        self._call({"cmd": "push_sparse", "keys": np.asarray(keys),
+                    "rows": rows, "table": table})
 
     def pull_dense(self, name: str) -> Optional[np.ndarray]:
         return self._call({"cmd": "pull_dense", "name": name})["value"]
 
     def push_dense(self, name: str, value: np.ndarray, add: bool = False):
-        self._call({"cmd": "push_dense", "name": name, "value": value,
-                    "add": add})
+        self._call({"cmd": "push_dense", "name": name,
+                    "value": np.asarray(value), "add": add})
 
-    def save(self, path: str, mode: str = "all") -> int:
-        return self._call({"cmd": "save", "path": path, "mode": mode})["saved"]
+    def save(self, path: str, mode: str = "all",
+             table: Optional[str] = None) -> int:
+        return self._call({"cmd": "save", "path": path, "mode": mode,
+                           "table": table})["saved"]
 
-    def load(self, path: str) -> int:
-        return self._call({"cmd": "load", "path": path})["loaded"]
+    def load(self, path: str, table: Optional[str] = None) -> int:
+        return self._call({"cmd": "load", "path": path,
+                           "table": table})["loaded"]
 
-    def shrink(self) -> int:
-        return self._call({"cmd": "shrink"})["removed"]
+    def shrink(self, table: Optional[str] = None) -> int:
+        return self._call({"cmd": "shrink", "table": table})["removed"]
 
-    def end_day(self) -> None:
-        self._call({"cmd": "end_day"})
+    def end_day(self, table: Optional[str] = None) -> None:
+        self._call({"cmd": "end_day", "table": table})
 
-    def size(self) -> int:
-        return self._call({"cmd": "size"})["size"]
+    def size(self, table: Optional[str] = None) -> int:
+        return self._call({"cmd": "size", "table": table})["size"]
+
+    def list_tables(self) -> Dict[str, int]:
+        return self._call({"cmd": "list_tables"})["tables"]
 
     def barrier(self, world: int) -> None:
         self._call({"cmd": "barrier", "world": world})
@@ -210,28 +263,29 @@ class PSClient:
 class RemoteTableAdapter:
     """Duck-types ShardedHostTable's pass-batched surface over a PSClient so
     BoxPSEngine can run against a remote PS
-    (engine.table = RemoteTableAdapter(client))."""
+    (engine.table = RemoteTableAdapter(client[, table]))."""
 
-    def __init__(self, client: PSClient):
+    def __init__(self, client: PSClient, table: Optional[str] = None):
         self.client = client
+        self.table = table
 
     def bulk_pull(self, keys):
-        return self.client.pull_sparse(keys)
+        return self.client.pull_sparse(keys, table=self.table)
 
     def bulk_write(self, keys, soa):
-        self.client.push_sparse(keys, soa)
+        self.client.push_sparse(keys, soa, table=self.table)
 
     def end_day(self):
-        self.client.end_day()
+        self.client.end_day(table=self.table)
 
     def shrink(self):
-        return self.client.shrink()
+        return self.client.shrink(table=self.table)
 
     def save(self, path, mode="all"):
-        return self.client.save(path, mode)
+        return self.client.save(path, mode, table=self.table)
 
     def load(self, path):
-        return self.client.load(path)
+        return self.client.load(path, table=self.table)
 
     def size(self):
-        return self.client.size()
+        return self.client.size(table=self.table)

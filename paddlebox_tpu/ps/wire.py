@@ -1,0 +1,162 @@
+"""Typed binary wire codec for the PS service — no pickle on network bytes.
+
+≙ the brpc PS protocol's typed request/response messages (sendrecv.proto:
+VariableMessage dtype/shape/raw-bytes framing + PsService cmd ids,
+ps/service/sendrecv.proto, brpc_ps_server.h): a message is a flat dict of
+scalars / strings / ndarrays / one-level dicts-of-ndarrays, encoded as
+tagged fields with dtype+shape headers and raw little-endian buffers.
+Arrays decode with np.frombuffer (zero parsing of untrusted structure
+beyond bounded headers) — a malicious peer can at worst produce a garbage
+array or a clean DecodeError, never code execution.
+
+Frame layout (all little-endian):
+  u32 field count, then per field:
+    u16 key-len, key utf8
+    u8 tag:  0 None | 1 bool | 2 int | 3 float | 4 str | 5 ndarray | 6 dict
+    value:
+      bool  -> u8
+      int   -> i64
+      float -> f64
+      str   -> u32 len + utf8
+      ndarray -> u8 dtype-len + dtype.str ascii, u8 ndim, u64*ndim shape,
+                 raw C-order bytes
+      dict  -> nested encoding (depth limited to 1 nesting level)
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict
+
+import numpy as np
+
+MAX_FRAME = 1 << 32          # hard cap: one frame can't ask for >4 GiB
+MAX_FIELDS = 4096
+MAX_KEY = 1 << 16
+_MAX_NDIM = 16
+
+
+class DecodeError(ValueError):
+    pass
+
+
+def _enc_value(out: list, v: Any, depth: int) -> None:
+    if v is None:
+        out.append(b"\x00")
+    elif isinstance(v, (bool, np.bool_)):
+        out.append(b"\x01" + struct.pack("<B", int(v)))
+    elif isinstance(v, (int, np.integer)):
+        out.append(b"\x02" + struct.pack("<q", int(v)))
+    elif isinstance(v, (float, np.floating)):
+        out.append(b"\x03" + struct.pack("<d", float(v)))
+    elif isinstance(v, str):
+        b = v.encode("utf-8")
+        out.append(b"\x04" + struct.pack("<I", len(b)) + b)
+    elif isinstance(v, np.ndarray):
+        a = np.ascontiguousarray(v)
+        dt = a.dtype.str.encode("ascii")
+        head = struct.pack("<B", len(dt)) + dt + struct.pack("<B", a.ndim)
+        head += struct.pack(f"<{a.ndim}Q", *a.shape) if a.ndim else b""
+        out.append(b"\x05" + head)
+        out.append(a.tobytes())
+    elif isinstance(v, dict):
+        if depth >= 1:
+            raise TypeError("wire dicts nest at most one level")
+        out.append(b"\x06")
+        _enc_fields(out, v, depth + 1)
+    else:
+        raise TypeError(f"wire cannot encode {type(v).__name__}")
+
+
+def _enc_fields(out: list, msg: Dict[str, Any], depth: int) -> None:
+    out.append(struct.pack("<I", len(msg)))
+    for k, v in msg.items():
+        kb = k.encode("utf-8")
+        out.append(struct.pack("<H", len(kb)) + kb)
+        _enc_value(out, v, depth)
+
+
+def encode(msg: Dict[str, Any]) -> bytes:
+    out: list = []
+    _enc_fields(out, msg, 0)
+    return b"".join(out)
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if n < 0 or self.pos + n > len(self.buf):
+            raise DecodeError("frame truncated")
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+    def unpack(self, fmt: str):
+        return struct.unpack(fmt, self.take(struct.calcsize(fmt)))
+
+
+def _dec_value(r: _Reader, depth: int) -> Any:
+    tag = r.u8()
+    if tag == 0:
+        return None
+    if tag == 1:
+        return bool(r.u8())
+    if tag == 2:
+        return r.unpack("<q")[0]
+    if tag == 3:
+        return r.unpack("<d")[0]
+    if tag == 4:
+        (n,) = r.unpack("<I")
+        return r.take(n).decode("utf-8")
+    if tag == 5:
+        dt_len = r.u8()
+        dt = np.dtype(r.take(dt_len).decode("ascii"))
+        if dt.hasobject:
+            raise DecodeError("object dtypes are not wire-safe")
+        ndim = r.u8()
+        if ndim > _MAX_NDIM:
+            raise DecodeError("ndim too large")
+        shape = r.unpack(f"<{ndim}Q") if ndim else ()
+        count = 1
+        for s in shape:
+            count *= s
+        nbytes = count * dt.itemsize
+        if nbytes > MAX_FRAME:
+            raise DecodeError("array exceeds frame cap")
+        raw = r.take(int(nbytes))
+        return np.frombuffer(raw, dtype=dt).reshape(shape).copy()
+    if tag == 6:
+        if depth >= 1:
+            raise DecodeError("dict nesting exceeds limit")
+        return _dec_fields(r, depth + 1)
+    raise DecodeError(f"unknown tag {tag}")
+
+
+def _dec_fields(r: _Reader, depth: int) -> Dict[str, Any]:
+    (n,) = r.unpack("<I")
+    if n > MAX_FIELDS:
+        raise DecodeError("too many fields")
+    out: Dict[str, Any] = {}
+    for _ in range(n):
+        (klen,) = r.unpack("<H")
+        if klen > MAX_KEY:
+            raise DecodeError("key too long")
+        k = r.take(klen).decode("utf-8")
+        out[k] = _dec_value(r, depth)
+    return out
+
+
+def decode(buf: bytes) -> Dict[str, Any]:
+    r = _Reader(buf)
+    msg = _dec_fields(r, 0)
+    if r.pos != len(buf):
+        raise DecodeError("trailing bytes in frame")
+    return msg

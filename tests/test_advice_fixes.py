@@ -68,7 +68,14 @@ def test_host_table_load_zero_inits_missing_fields(tmp_path):
     assert loaded == len(keys)
     pulled = dst.bulk_pull(keys)
     assert np.allclose(pulled["show"], rows["show"])  # real data survived
+    shard = dst._shards[0]
+    sgd = cfg_adam.sgd
     for f in extra:
-        got = pulled.get(f)
-        if got is not None:
-            assert np.all(got == 0)  # missing state zero-initialized
+        arr = shard.soa[f]
+        if f.endswith("_b1p"):      # beta-power trackers start at the
+            exp = sgd.beta1_decay_rate   # decay rates, like fresh rows —
+        elif f.endswith("_b2p"):    # zeros would disable bias correction
+            exp = sgd.beta2_decay_rate   # forever (multiplicative update)
+        else:
+            exp = 0.0
+        assert np.all(arr == exp), (f, arr[:3], exp)
