@@ -10,18 +10,72 @@ processes without MPI.
 
 from __future__ import annotations
 
-import pickle
 import socket
 import struct
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from paddlebox_tpu.data.dataset import ShuffleTransport
 from paddlebox_tpu.data.slot_record import SlotRecordBlock
+from paddlebox_tpu.ps import wire
 from paddlebox_tpu.utils.channel import Channel
 
 _MSG_BLOCK = 0
 _MSG_DONE = 1
+
+
+def block_to_wire(block: SlotRecordBlock) -> bytes:
+    """SlotRecordBlock → typed wire frame (ps/wire.py codec — dtype/shape
+    headers + raw buffers, never pickle on network bytes)."""
+    msg: Dict[str, object] = {"n": block.n}
+    msg["u"] = {}
+    msg["uo"] = {}
+    for name, (vals, offs) in block.uint64_slots.items():
+        msg["u"][name] = np.asarray(vals)
+        msg["uo"][name] = np.asarray(offs)
+    msg["f"] = {}
+    msg["fo"] = {}
+    for name, (vals, offs) in block.float_slots.items():
+        msg["f"][name] = np.asarray(vals)
+        msg["fo"][name] = np.asarray(offs)
+    if block.ins_ids is not None:
+        if any("\x00" in i for i in block.ins_ids):
+            raise ValueError("ins_ids may not contain NUL bytes")
+        # explicit count disambiguates [] vs [""] (and trailing empties)
+        msg["ins_ids"] = "\x00".join(block.ins_ids)
+        msg["ins_ids_n"] = len(block.ins_ids)
+    for f in ("search_ids", "cmatch", "rank"):
+        v = getattr(block, f)
+        if v is not None:
+            msg[f] = np.asarray(v)
+    return wire.encode(msg)
+
+
+def block_from_wire(payload: bytes) -> SlotRecordBlock:
+    try:
+        msg = wire.decode(payload)
+        blk = SlotRecordBlock(n=int(msg["n"]))
+        for name, vals in msg.get("u", {}).items():
+            blk.uint64_slots[name] = (vals, msg["uo"][name])
+        for name, vals in msg.get("f", {}).items():
+            blk.float_slots[name] = (vals, msg["fo"][name])
+        if "ins_ids" in msg:
+            n_ids = int(msg["ins_ids_n"])
+            ids = msg["ins_ids"].split("\x00") if n_ids else []
+            if len(ids) != n_ids:
+                raise ValueError("ins_ids count mismatch")
+            blk.ins_ids = ids
+        for f in ("search_ids", "cmatch", "rank"):
+            if f in msg:
+                setattr(blk, f, msg[f])
+        return blk
+    except wire.DecodeError:
+        raise
+    except (KeyError, TypeError, ValueError, AttributeError) as e:
+        # decodable frame, wrong structure — same remedy as a bad frame
+        raise wire.DecodeError(f"malformed block frame: {e!r}") from e
 
 
 def _send_msg(sock: socket.socket, kind: int, payload: bytes) -> None:
@@ -29,13 +83,13 @@ def _send_msg(sock: socket.socket, kind: int, payload: bytes) -> None:
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
+    buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
             raise ConnectionError("peer closed")
-        buf += chunk
-    return buf
+        buf.extend(chunk)
+    return bytes(buf)
 
 
 class TcpShuffleTransport(ShuffleTransport):
@@ -82,15 +136,18 @@ class TcpShuffleTransport(ShuffleTransport):
             while True:
                 head = _recv_exact(conn, 9)
                 kind, length = struct.unpack("<BQ", head)
+                if length > wire.MAX_FRAME:
+                    raise ConnectionError(
+                        f"oversized shuffle frame ({length} bytes)")
                 payload = _recv_exact(conn, length) if length else b""
                 if kind == _MSG_BLOCK:
-                    self._mail.put(pickle.loads(payload))
+                    self._mail.put(block_from_wire(payload))
                 elif kind == _MSG_DONE:
                     src = struct.unpack("<I", payload)[0]
                     with self._done_cv:
                         self._done_from.add(src)
                         self._done_cv.notify_all()
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, wire.DecodeError):
             return
 
     def _conn_to(self, dst: int) -> socket.socket:
@@ -102,7 +159,7 @@ class TcpShuffleTransport(ShuffleTransport):
 
     # ------------------------------------------------------------------
     def send(self, dst: int, block: SlotRecordBlock) -> None:
-        payload = pickle.dumps(block, protocol=pickle.HIGHEST_PROTOCOL)
+        payload = block_to_wire(block)
         sock = self._conn_to(dst)
         with self._conn_lock:
             _send_msg(sock, _MSG_BLOCK, payload)

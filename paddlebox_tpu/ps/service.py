@@ -76,6 +76,9 @@ class PSServer:
             self.tables = {DEFAULT_TABLE: table}
         self.dense: Dict[str, np.ndarray] = {}
         self._dense_lock = threading.Lock()
+        # per-table: delta merges need read-modify-write atomicity only
+        # against the SAME table; unrelated tables stay concurrent
+        self._delta_locks = {name: threading.Lock() for name in self.tables}
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
@@ -120,10 +123,39 @@ class PSServer:
     def _dispatch(self, req: Dict) -> Dict:
         cmd = req["cmd"]
         if cmd == "pull_sparse":
-            rows = self._table(req).bulk_pull(req["keys"])
+            t = self._table(req)
+            if req.get("create"):
+                # persist fresh-row defaults on first pull so every worker
+                # of a multi-trainer job sees identical base values
+                # (delta write-back sums against a common base)
+                with self._delta_locks[req.get("table") or DEFAULT_TABLE]:
+                    rows = t.bulk_pull(req["keys"])
+                    t.bulk_write(req["keys"], rows)
+            else:
+                rows = t.bulk_pull(req["keys"])
             return {"ok": True, "rows": rows}
         if cmd == "push_sparse":
             self._table(req).bulk_write(req["keys"], req["rows"])
+            return {"ok": True}
+        if cmd == "push_sparse_delta":
+            # geo/Hogwild-style merge for concurrent trainers: read-modify-
+            # write under a lock so two workers' pass deltas SUM instead of
+            # last-wins (≙ multi-node grad aggregation,
+            # heter_comm_inl.h:2027 gather_one_node_grad + local merge).
+            # Non-summable fields (slot, mf_size, beta powers) arrive as
+            # absolute values and overwrite.
+            t = self._table(req)
+            with self._delta_locks[req.get("table") or DEFAULT_TABLE]:
+                cur = t.bulk_pull(req["keys"])
+                for f, d in req["rows"].items():
+                    if f in cur:
+                        cur[f] = cur[f] + d
+                for f, v in (req.get("rows_abs") or {}).items():
+                    if f in cur:
+                        cur[f] = v
+                if "unseen_days" in cur:
+                    cur["unseen_days"] = np.zeros_like(cur["unseen_days"])
+                t.bulk_write(req["keys"], cur)
             return {"ok": True}
         if cmd == "pull_dense":
             with self._dense_lock:
@@ -192,14 +224,19 @@ class PSClient:
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
-    def _call(self, req: Dict) -> Dict:
+    def _call(self, req: Dict, retry: bool = True,
+              timeout: float = 60) -> Dict:
+        """retry=False for non-idempotent verbs (delta merges, barrier):
+        a resend after an ambiguous failure could apply twice — fail loud
+        and let the pass-level recovery decide."""
         last_err = None
-        for _ in range(self.retries):
+        for _ in range(self.retries if retry else 1):
             try:
                 with self._lock:
                     if self._sock is None:
                         self._sock = socket.create_connection(self.addr,
                                                               timeout=60)
+                    self._sock.settimeout(timeout)
                     _send(self._sock, req)
                     resp = _recv(self._sock)
                 if not resp.get("ok"):
@@ -214,19 +251,31 @@ class PSClient:
                         except OSError:
                             pass
                         self._sock = None
+                if not retry:
+                    raise ConnectionError(
+                        f"ps call {req.get('cmd')!r} failed (not retried — "
+                        f"non-idempotent): {last_err}") from e
                 time.sleep(self.retry_sleep)
         raise ConnectionError(f"ps unreachable after retries: {last_err}")
 
     # -- verbs (table=None → the default table) -----------------------------
-    def pull_sparse(self, keys: np.ndarray,
-                    table: Optional[str] = None) -> Dict[str, np.ndarray]:
+    def pull_sparse(self, keys: np.ndarray, table: Optional[str] = None,
+                    create: bool = False) -> Dict[str, np.ndarray]:
         return self._call({"cmd": "pull_sparse", "keys": np.asarray(keys),
-                           "table": table})["rows"]
+                           "table": table, "create": create})["rows"]
 
     def push_sparse(self, keys: np.ndarray, rows: Dict[str, np.ndarray],
                     table: Optional[str] = None):
         self._call({"cmd": "push_sparse", "keys": np.asarray(keys),
                     "rows": rows, "table": table})
+
+    def push_sparse_delta(self, keys: np.ndarray,
+                          rows: Dict[str, np.ndarray],
+                          rows_abs: Optional[Dict[str, np.ndarray]] = None,
+                          table: Optional[str] = None):
+        self._call({"cmd": "push_sparse_delta", "keys": np.asarray(keys),
+                    "rows": rows, "rows_abs": rows_abs or {},
+                    "table": table}, retry=False)
 
     def pull_dense(self, name: str) -> Optional[np.ndarray]:
         return self._call({"cmd": "pull_dense", "name": name})["value"]
@@ -256,24 +305,72 @@ class PSClient:
     def list_tables(self) -> Dict[str, int]:
         return self._call({"cmd": "list_tables"})["tables"]
 
-    def barrier(self, world: int) -> None:
-        self._call({"cmd": "barrier", "world": world})
+    def barrier(self, world: int, timeout: float = 120) -> None:
+        # no retry (a resend would double-register this participant) and a
+        # client timeout LONGER than the server's wait window, so the
+        # server side always resolves (release or rollback) first
+        self._call({"cmd": "barrier", "world": world}, retry=False,
+                   timeout=timeout)
 
 
 class RemoteTableAdapter:
     """Duck-types ShardedHostTable's pass-batched surface over a PSClient so
     BoxPSEngine can run against a remote PS
-    (engine.table = RemoteTableAdapter(client[, table]))."""
+    (engine.table = RemoteTableAdapter(client[, table])).
 
-    def __init__(self, client: PSClient, table: Optional[str] = None):
+    delta_mode=True is the multi-trainer contract: bulk_pull snapshots the
+    pulled rows (and asks the server to persist fresh-row defaults so every
+    worker shares one base), bulk_write sends (new - snapshot) and the
+    server SUMS concurrent workers' deltas — pass-granular Hogwild, the
+    pass-lifecycle analogue of multi-node sparse grad aggregation
+    (heter_comm_inl.h:2027/2131)."""
+
+    def __init__(self, client: PSClient, table: Optional[str] = None,
+                 delta_mode: bool = False):
         self.client = client
         self.table = table
+        self.delta_mode = delta_mode
+        # snapshots keyed by key-set digest: the engine pulls from several
+        # sites (pass build, async preload of the NEXT pass, stale-row
+        # refresh) and a single slot would be clobbered before write-back
+        self._snaps: Dict[bytes, Dict[str, np.ndarray]] = {}
+        self._snap_cap = 4
 
     def bulk_pull(self, keys):
-        return self.client.pull_sparse(keys, table=self.table)
+        rows = self.client.pull_sparse(keys, table=self.table,
+                                       create=self.delta_mode)
+        if self.delta_mode:
+            digest = np.asarray(keys, np.uint64).tobytes()
+            if len(self._snaps) >= self._snap_cap:
+                self._snaps.pop(next(iter(self._snaps)))  # oldest out
+            self._snaps[digest] = {f: np.array(v, copy=True)
+                                   for f, v in rows.items()}
+        return rows
+
+    # fields where "sum of two workers' changes" is wrong — sent absolute
+    NON_ACCUMULABLE = ("slot", "mf_size")
+    NON_ACCUMULABLE_SUFFIX = ("_b1p", "_b2p")
+
+    def _is_abs(self, f: str) -> bool:
+        return (f in self.NON_ACCUMULABLE
+                or f.endswith(self.NON_ACCUMULABLE_SUFFIX))
 
     def bulk_write(self, keys, soa):
-        self.client.push_sparse(keys, soa, table=self.table)
+        if not self.delta_mode:
+            return self.client.push_sparse(keys, soa, table=self.table)
+        digest = np.asarray(keys, np.uint64).tobytes()
+        snap = self._snaps.pop(digest, None)
+        if snap is None:
+            raise RuntimeError(
+                "delta_mode write-back without a matching pull snapshot — "
+                "the written key set must equal a previously pulled one")
+        delta = {f: v - snap[f] for f, v in soa.items()
+                 if f in snap and f != "unseen_days"
+                 and not self._is_abs(f)}
+        rows_abs = {f: np.asarray(v) for f, v in soa.items()
+                    if self._is_abs(f)}
+        self.client.push_sparse_delta(keys, delta, rows_abs=rows_abs,
+                                      table=self.table)
 
     def end_day(self):
         self.client.end_day(table=self.table)

@@ -53,6 +53,10 @@ def _enc_value(out: list, v: Any, depth: int) -> None:
         b = v.encode("utf-8")
         out.append(b"\x04" + struct.pack("<I", len(b)) + b)
     elif isinstance(v, np.ndarray):
+        if v.dtype.hasobject:
+            raise TypeError(
+                "object-dtype arrays are not wire-safe (raw pointers); "
+                "convert to a fixed-width dtype first")
         a = np.ascontiguousarray(v)
         dt = a.dtype.str.encode("ascii")
         head = struct.pack("<B", len(dt)) + dt + struct.pack("<B", a.ndim)
