@@ -98,6 +98,7 @@ class TcpShuffleTransport(ShuffleTransport):
         self._addrs = list(addrs)
         self._world = len(addrs)
         self._mail = Channel()
+        self._rx_error = None
         self._done_from = set()
         self._done_lock = threading.Lock()
         self._done_cv = threading.Condition(self._done_lock)
@@ -147,7 +148,14 @@ class TcpShuffleTransport(ShuffleTransport):
                     with self._done_cv:
                         self._done_from.add(src)
                         self._done_cv.notify_all()
-        except (ConnectionError, OSError, wire.DecodeError):
+        except (ConnectionError, OSError):
+            return
+        except wire.DecodeError as e:
+            # a corrupt frame means lost records — poison the barrier so
+            # the pass FAILS loudly instead of hanging or training short
+            with self._done_cv:
+                self._rx_error = e
+                self._done_cv.notify_all()
             return
 
     def _conn_to(self, dst: int) -> socket.socket:
@@ -176,11 +184,18 @@ class TcpShuffleTransport(ShuffleTransport):
                 _send_msg(sock, _MSG_DONE, me)
         with self._done_cv:
             while len(self._done_from) < self._world - 1:
+                if self._rx_error is not None:
+                    raise RuntimeError(
+                        "shuffle receive failed — records lost"
+                    ) from self._rx_error
                 if not self._done_cv.wait(timeout=60):
                     raise TimeoutError("shuffle barrier timed out")
             self._done_from.clear()
 
     def drain(self) -> List[SlotRecordBlock]:
+        if self._rx_error is not None:
+            raise RuntimeError("shuffle receive failed — records lost"
+                               ) from self._rx_error
         out = []
         while self._mail.size():
             out.append(self._mail.get())

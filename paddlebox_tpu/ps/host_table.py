@@ -4,12 +4,14 @@
 ``key % shard_num`` (memory_sparse_table.h:46-59), bulk Pull/Push
 (:61-97), Save/Load with per-shard files, Shrink via accessor policy.
 
-TPU-first storage: each shard keeps its keys in one *sorted* uint64 array
-with parallel SoA value arrays — bulk lookup is a vectorized
-``np.searchsorted`` and pass-level merge is an O(n) sorted union, matching
-the pass-batched access pattern (one pull at end_feed_pass, one write-back at
-end_pass) instead of the reference's per-request hash probes.  A native C++
-hash shard (paddlebox_tpu/native/) can be slotted in for point lookups.
+TPU-first storage: each shard keeps its keys in one insertion-ordered
+uint64 array with parallel SoA value arrays, indexed by the native C++
+open-addressing hash (native/hash_shard.cc) — bulk lookup is one threaded
+probe sweep and pass-level write-back is overwrite + append, never a
+whole-shard re-sort.  Without the native library the index falls back to a
+lazily rebuilt sorted view + ``np.searchsorted``.  This matches the
+pass-batched access pattern (one pull at end_feed_pass, one write-back at
+end_pass) instead of the reference's per-request hash probes.
 """
 
 from __future__ import annotations
@@ -31,39 +33,81 @@ class _Shard:
         self.keys = np.empty((0,), np.uint64)
         self.soa = fv.empty_soa(0, mf_dim, expand_dim, adam, optimizer)
         self.mf_dim = mf_dim
-        self.lock = threading.Lock()
+        # RLock: lookup lazily builds index state (native hash / sorted
+        # view) and is called both bare (readers) and from under upsert
+        self.lock = threading.RLock()
+        self._hash = None           # native index (row = insertion order)
+        self._hash_tried = False
+        self._sorted_view = None    # fallback: (sorted_keys, order)
 
     @property
     def size(self) -> int:
         return len(self.keys)
 
+    def _native(self):
+        if not self._hash_tried:
+            self._hash_tried = True
+            try:
+                from paddlebox_tpu.native import hash_map
+                if hash_map.available():
+                    h = hash_map.NativeKeyHash(max(len(self.keys), 1024))
+                    if len(self.keys):
+                        h.upsert(self.keys)
+                    self._hash = h
+            except Exception:
+                self._hash = None
+        return self._hash
+
+    def rebuild_index(self) -> None:
+        """Call after keys/soa were replaced wholesale (load, shrink)."""
+        self._sorted_view = None
+        if self._hash is not None or self._hash_tried:
+            self._hash_tried = False
+            self._hash = None
+            self._native()
+
     def lookup(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        """→ (positions, found_mask); positions valid where found."""
-        if len(self.keys) == 0:
-            return (np.zeros(len(keys), np.int64),
-                    np.zeros(len(keys), bool))
-        pos = np.searchsorted(self.keys, keys)
-        pos_c = np.minimum(pos, len(self.keys) - 1)
-        found = self.keys[pos_c] == keys
-        return pos_c, found
+        """→ (rows, found_mask); rows are insertion positions, valid where
+        found.  Thread-safe: lazily builds index state under the shard
+        lock (reentrant from upsert)."""
+        with self.lock:
+            if len(self.keys) == 0:
+                return (np.zeros(len(keys), np.int64),
+                        np.zeros(len(keys), bool))
+            h = self._native()
+            if h is not None:
+                rows = h.find(np.asarray(keys, np.uint64))
+                return np.maximum(rows, 0), rows >= 0
+            if self._sorted_view is None:
+                order = np.argsort(self.keys, kind="stable")
+                self._sorted_view = (self.keys[order], order)
+            sk, order = self._sorted_view
+            pos = np.searchsorted(sk, keys)
+            pos_c = np.minimum(pos, len(sk) - 1)
+            found = sk[pos_c] == keys
+            return order[pos_c], found
 
     def upsert(self, keys: np.ndarray, soa: Dict[str, np.ndarray]) -> None:
-        """Sorted-merge write: overwrite existing rows, insert new ones."""
+        """Overwrite existing rows in place, append new ones — no re-sort
+        (keys must be unique within one call, which pass-level write-back
+        guarantees)."""
         with self.lock:
-            pos, found = self.lookup(keys)
+            rows, found = self.lookup(keys)
             if found.any():
-                idx = pos[found]
+                idx = rows[found]
                 for f, arr in self.soa.items():
                     arr[idx] = soa[f][found]
             if (~found).any():
                 new_keys = keys[~found]
-                merged_keys = np.concatenate([self.keys, new_keys])
-                order = np.argsort(merged_keys, kind="stable")
-                self.keys = merged_keys[order]
+                if self._hash is not None:
+                    # native insertion rows continue from the current size,
+                    # matching the append positions exactly
+                    self._hash.upsert(new_keys)
+                self.keys = np.concatenate([self.keys, new_keys])
                 for f in self.soa:
-                    merged = np.concatenate(
+                    self.soa[f] = np.concatenate(
                         [self.soa[f], soa[f][~found]])
-                    self.soa[f] = merged[order]
+                self._sorted_view = None
 
 
 class ShardedHostTable:
@@ -154,6 +198,7 @@ class ShardedHostTable:
                 shard.keys = shard.keys[keep]
                 for f in shard.soa:
                     shard.soa[f] = shard.soa[f][keep]
+                shard.rebuild_index()
         return removed
 
     # -- persistence (≙ SaveBase/SaveDelta box_wrapper.cc:1286; per-shard
@@ -209,5 +254,6 @@ class ShardedHostTable:
                         name: (z[name] if name in z.files else
                                init_missing(name, tmpl))
                         for name, tmpl in shard.soa.items()}
+                    shard.rebuild_index()
             loaded += shard.size
         return loaded

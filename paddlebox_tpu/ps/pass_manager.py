@@ -184,6 +184,12 @@ class BoxPSEngine:
             return
         with self.timers("refresh_stale"):
             fresh = self.table.bulk_pull(stale)
+            if hasattr(self.table, "patch_snapshot"):
+                # delta-mode remote tables: the refreshed values must also
+                # replace the write-back base for these rows (service.py
+                # RemoteTableAdapter.patch_snapshot)
+                self.table.patch_snapshot(self.mapper.sorted_keys, stale,
+                                          fresh)
             rows = jnp.asarray(self.mapper(stale))
             for f in self.ws:
                 if f in fresh:

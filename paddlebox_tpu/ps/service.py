@@ -355,6 +355,26 @@ class RemoteTableAdapter:
         return (f in self.NON_ACCUMULABLE
                 or f.endswith(self.NON_ACCUMULABLE_SUFFIX))
 
+    def patch_snapshot(self, full_keys, sub_keys, rows) -> None:
+        """The engine refreshed a SUBSET of an earlier pull (stale-row
+        refresh after an async preload): fold the fresh values into the
+        full pull's snapshot, or the next delta re-applies whatever peers
+        (and this worker's previous pass) already pushed for those rows.
+        Also drops the subset pull's own snapshot (it will never be
+        written back)."""
+        if not self.delta_mode:
+            return
+        full = np.asarray(full_keys, np.uint64)
+        sub = np.asarray(sub_keys, np.uint64)
+        self._snaps.pop(sub.tobytes(), None)
+        snap = self._snaps.get(full.tobytes())
+        if snap is None:
+            return
+        pos = np.searchsorted(full, sub)   # full pass keys are sorted
+        for f, v in rows.items():
+            if f in snap:
+                snap[f][pos] = v
+
     def bulk_write(self, keys, soa):
         if not self.delta_mode:
             return self.client.push_sparse(keys, soa, table=self.table)

@@ -15,7 +15,6 @@ Compaction rewrites live rows (≙ rocksdb compaction, triggered by Shrink).
 from __future__ import annotations
 
 import os
-import struct
 import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -46,55 +45,74 @@ class SSDShard:
                 f.write(_MAGIC)
 
     def _rebuild_index(self) -> None:
-        size = os.path.getsize(self.path)
         with open(self.path, "rb") as f:
             assert f.read(8) == _MAGIC, "corrupt ssd shard file"
-            off = 8
-            while off + self.row_bytes <= size:
-                key = struct.unpack("<Q", f.read(8))[0]
-                f.seek(4 * self.width, 1)
-                self.index[key] = off
-                off += self.row_bytes
+            raw = f.read()
+        usable = len(raw) // self.row_bytes * self.row_bytes
+        rec = np.frombuffer(raw[:usable], self._rec_dtype)
+        rb = self.row_bytes
+        for i, k in enumerate(rec["k"].tolist()):
+            self.index[k] = 8 + i * rb   # later rows win (log order)
 
-    def _encode(self, soa: Dict[str, np.ndarray], i: int) -> bytes:
-        scalars = np.array([soa[f][i] for f in self.scalar_fields],
-                           np.float32)
-        return scalars.tobytes() + soa["mf"][i].astype(np.float32).tobytes()
-
-    def _decode(self, payload: bytes) -> Dict[str, np.ndarray]:
-        arr = np.frombuffer(payload, np.float32)
-        out = {}
-        for j, f in enumerate(self.scalar_fields):
-            out[f] = arr[j]
-        out["mf"] = arr[len(self.scalar_fields):].copy()
-        return out
+    @property
+    def _rec_dtype(self) -> np.dtype:
+        return np.dtype([("k", "<u8"), ("v", "<f4", (self.width,))])
 
     def write_rows(self, keys: np.ndarray, soa: Dict[str, np.ndarray]) -> None:
-        with self._lock, open(self.path, "ab") as f:
-            for i, k in enumerate(keys):
-                off = f.tell()
-                f.write(struct.pack("<Q", int(k)))
-                f.write(self._encode(soa, i))
-                self.index[int(k)] = off
+        """One pack + one write per block (≙ rocksdb WriteBatch): the whole
+        batch serializes vectorized into a structured record array."""
+        n = len(keys)
+        if n == 0:
+            return
+        rec = np.empty((n,), self._rec_dtype)
+        rec["k"] = np.asarray(keys, np.uint64)
+        for j, f in enumerate(self.scalar_fields):
+            rec["v"][:, j] = soa[f]
+        rec["v"][:, len(self.scalar_fields):] = soa["mf"]
+        with self._lock, open(self.path, "ab") as fh:
+            off0 = fh.tell()
+            fh.write(rec.tobytes())
+            rb = self.row_bytes
+            idx = self.index
+            for i, k in enumerate(np.asarray(keys, np.uint64).tolist()):
+                idx[k] = off0 + i * rb
 
     def read_rows(self, keys: np.ndarray
                   ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
-        """→ (soa rows aligned to keys, found mask); missing rows zeroed."""
+        """→ (soa rows aligned to keys, found mask); missing rows zeroed.
+        Offsets sort + coalesce into contiguous runs, so a pass's rows
+        (written together) come back as a handful of sequential reads."""
         n = len(keys)
         soa = fv.empty_soa(n, self.mf_dim)
         found = np.zeros(n, bool)
-        order = sorted(range(n),
-                       key=lambda i: self.index.get(int(keys[i]), -1))
-        with self._lock, open(self.path, "rb") as f:
-            for i in order:
-                off = self.index.get(int(keys[i]))
-                if off is None:
-                    continue
-                f.seek(off + 8)
-                row = self._decode(f.read(4 * self.width))
-                for name, v in row.items():
-                    soa[name][i] = v
-                found[i] = True
+        # one lock span for offsets + reads: a concurrent compact() swaps
+        # the file and would invalidate a pre-snapshotted offset list
+        with self._lock:
+            offs = np.array([self.index.get(int(k), -1) for k in keys],
+                            np.int64)
+            hit = offs >= 0
+            if not hit.any():
+                return soa, found
+            found[:] = hit
+            hit_idx = np.nonzero(hit)[0]
+            order = np.argsort(offs[hit_idx], kind="stable")
+            hit_idx = hit_idx[order]
+            sorted_offs = offs[hit_idx]
+            rb = self.row_bytes
+            # coalesce adjacent rows into runs: one pread per run
+            breaks = np.nonzero(np.diff(sorted_offs) != rb)[0] + 1
+            starts = np.concatenate([[0], breaks])
+            ends = np.concatenate([breaks, [len(sorted_offs)]])
+            vals = np.empty((len(sorted_offs), self.width), np.float32)
+            with open(self.path, "rb") as fh:
+                for s, e in zip(starts, ends):
+                    fh.seek(sorted_offs[s])
+                    raw = fh.read(int((e - s) * rb))
+                    rec = np.frombuffer(raw, self._rec_dtype)
+                    vals[s:e] = rec["v"]
+        for j, f in enumerate(self.scalar_fields):
+            soa[f][hit_idx] = vals[:, j]
+        soa["mf"][hit_idx] = vals[:, len(self.scalar_fields):]
         return soa, found
 
     def delete(self, keys: np.ndarray) -> None:
@@ -146,6 +164,22 @@ class SSDTieredTable:
     def _shard_ids(self, keys):
         return self.host._shard_ids(keys)
 
+    def spill_topk(self, cache_rows: int) -> int:
+        """Keep only the `cache_rows` highest-scoring rows in DRAM, demote
+        the rest (≙ the `_cache_tk_size` top-k cache-threshold policy,
+        ssd_sparse_table.h:82: the threshold is the k-th score, computed
+        over the whole table, not a fixed constant)."""
+        scores = [self.host._score(s.soa) for s in self.host._shards]
+        all_scores = np.concatenate(scores) if scores else np.empty((0,))
+        if len(all_scores) <= cache_rows:
+            return 0
+        if cache_rows <= 0:
+            return self.spill(np.inf)   # demote everything
+        # threshold = (n - cache_rows)-th smallest → top cache_rows stay
+        thr = np.partition(all_scores, len(all_scores) - cache_rows)[
+            len(all_scores) - cache_rows]
+        return self.spill(thr)
+
     def spill(self, score_threshold: float) -> int:
         """Demote host rows with score < threshold to SSD."""
         spilled = 0
@@ -162,6 +196,7 @@ class SSDTieredTable:
                 shard.keys = shard.keys[keep]
                 for f in shard.soa:
                     shard.soa[f] = shard.soa[f][keep]
+                shard.rebuild_index()
                 spilled += int(cold.sum())
         return spilled
 

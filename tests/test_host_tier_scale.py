@@ -1,0 +1,64 @@
+"""Host-tier scalability guards: a 10M-key pass (pull + write-back +
+spill + fault-back) must complete in seconds, not minutes (VERDICT round-3
+task #5 done-criterion).  The budget assertions are ~4x the measured
+single-CPU times so they catch order-of-magnitude regressions (the
+re-sorting upsert this replaced, per-row SSD IO) without CI flakes."""
+
+import time
+
+import numpy as np
+
+from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+from paddlebox_tpu.ps.host_table import ShardedHostTable
+from paddlebox_tpu.ps.ssd_table import SSDTieredTable
+
+N_KEYS = 10_000_000
+MF = 4
+
+
+def test_ten_million_key_pass_in_seconds(tmp_path):
+    table = ShardedHostTable(EmbeddingTableConfig(
+        embedding_dim=MF, shard_num=8,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    rng = np.random.default_rng(0)
+    keys = np.unique(rng.integers(1, 1 << 50, size=N_KEYS, dtype=np.uint64))
+
+    t0 = time.perf_counter()
+    rows = table.bulk_pull(keys)
+    t_pull = time.perf_counter() - t0
+
+    rows["show"] = rows["show"] + 1.0
+    rows["unseen_days"] = np.zeros((len(keys),), np.float32)
+    t0 = time.perf_counter()
+    table.bulk_write(keys, rows)
+    t_write = time.perf_counter() - t0
+    assert table.size() == len(keys)
+
+    # second pass over half the keys: pure overwrite, no append
+    half = keys[::2]
+    t0 = time.perf_counter()
+    rows2 = table.bulk_pull(half)
+    rows2["show"] = rows2["show"] + 1.0
+    table.bulk_write(half, rows2)
+    t_pass2 = time.perf_counter() - t0
+    out = table.bulk_pull(half[:1000])
+    assert np.all(out["show"] == 2.0)
+
+    # spill the cold ~half to SSD (top-k cache threshold), fault some back
+    tiered = SSDTieredTable(table, str(tmp_path))
+    t0 = time.perf_counter()
+    spilled = tiered.spill_topk(len(keys) // 2)
+    t_spill = time.perf_counter() - t0
+    assert spilled > 0 and table.size() == len(keys) - spilled
+
+    probe = keys[:200_000]
+    t0 = time.perf_counter()
+    back = tiered.bulk_pull(probe)
+    t_fault = time.perf_counter() - t0
+    assert np.all(back["show"] >= 1.0)
+
+    times = {"pull": t_pull, "write": t_write, "pass2": t_pass2,
+             "spill": t_spill, "fault_200k": t_fault}
+    total = sum(times.values())
+    assert total < 120, times           # "in seconds" — hard ceiling
+    assert t_write < 30 and t_pass2 < 30, times
