@@ -79,6 +79,11 @@ class SparseSGDConfig:
     mf_max_bound: float = 10.0
     feature_learning_rate: float = 0.05
     nodeid_slot: int = 9008
+    # per-slot mf widths (≙ CtrDymfAccessor's dynamic embedx dim,
+    # ctr_dymf_accessor.h + feature_value.h:42): ((slot_id, dim), ...).
+    # Lives on the SGD config because the update rules consume it (the
+    # mean-square divisor / moment means use the row's true dim).
+    slot_mf_dims: Tuple[Tuple[int, int], ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -109,6 +114,21 @@ class EmbeddingTableConfig:
     quant_bits: int = 0              # 0 = no embedding quantization
     expand_dim: int = 0              # NNCross second embedding width
                                      # (≙ expand_embed_dim, pull_box_extended)
+
+    def slot_mf_dim(self, slot_id: int) -> int:
+        """Slot's mf width under the dynamic-dim accessor (sgd.slot_mf_dims,
+        ≙ CtrDymfAccessor); defaults to embedding_dim.  TPU-first layout:
+        storage stays at embedding_dim (static shapes); a slot with dim
+        d < embedding_dim trains/pulls only its first d columns — pulls
+        mask the tail to zero, the optimizer scales by the row's true dim."""
+        for sid, d in self.sgd.slot_mf_dims:
+            if sid == slot_id:
+                if d > self.embedding_dim:
+                    raise ValueError(
+                        f"slot {sid} mf dim {d} exceeds embedding_dim "
+                        f"{self.embedding_dim}")
+                return d
+        return self.embedding_dim
 
 
 @dataclasses.dataclass(frozen=True)

@@ -122,7 +122,14 @@ def push_and_update(ws: Dict[str, jnp.ndarray], idx: jnp.ndarray,
     score = cfg.nonclk_coeff * (show - click) + cfg.clk_coeff * click
     create = touched & (ws["mf_size"] == 0) & \
         (score >= cfg.mf_create_thresholds)
-    mf_size = jnp.where(create, D, ws["mf_size"])
+    # dynamic per-slot dims (≙ CtrDymfAccessor): created rows record their
+    # slot's true width, resolved from the MERGED row slot (same chain the
+    # optimizer rules use — keeps multi-slot keys deterministic)
+    from paddlebox_tpu.ps.optimizer import _dym_dims
+    dims_row = _dym_dims(cfg, slot, D)
+    mf_size = jnp.where(create,
+                        dims_row if dims_row is not None else D,
+                        ws["mf_size"])
 
     # -- mf: batch-domain row updates (no [N, D] full pass) ---------------
     # gather merged values back per occurrence; every occurrence of a row
@@ -137,7 +144,14 @@ def push_and_update(ws: Dict[str, jnp.ndarray], idx: jnp.ndarray,
     r_mf = ws["mf"][flat]
     new_mf = jnp.clip(r_mf + r_g * r_ratio[:, None],
                       cfg.mf_min_bound, cfg.mf_max_bound)
-    new_g2 = r_g2 + jnp.sum(r_g * r_g, axis=1) / D
+    # mean-square divisor is the ROW's true dim (merged slot, gathered per
+    # occurrence like the other row state — every occurrence of a row then
+    # computes the identical update, preserving the .set determinism)
+    if dims_row is not None:
+        new_g2 = r_g2 + jnp.sum(r_g * r_g, axis=1) \
+            / dims_row[flat].astype(jnp.float32)
+    else:
+        new_g2 = r_g2 + jnp.sum(r_g * r_g, axis=1) / D
     write_idx = jnp.where(r_trainable, flat, 0)
     mf = ws["mf"].at[write_idx].set(
         jnp.where(r_trainable[:, None], new_mf, ws["mf"][0][None, :]))

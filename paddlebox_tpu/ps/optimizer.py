@@ -28,10 +28,13 @@ from paddlebox_tpu.config import SparseSGDConfig
 
 
 def _adagrad_update(w, g2sum, g, scale, lr, initial_g2sum, min_bound,
-                    max_bound, touched, n_dim: int):
+                    max_bound, touched, n_dim):
     """≙ update_value_work (optimizer.cuh.h:43-73), vectorized over rows.
 
     w: [N] or [N,D]; g2sum: [N]; g: same shape as w; scale: [N] (g_show).
+    n_dim: the embedx group width — a scalar, or per-row [N] ints for
+    dynamic mf dims (≙ CtrDymfAccessor: the mean-square divisor is the
+    row's TRUE dim; tail-column grads arrive as exact zeros).
     """
     safe_scale = jnp.where(scale > 0, scale, 1.0)
     ratio = lr * jnp.sqrt(initial_g2sum / (initial_g2sum + g2sum))
@@ -68,7 +71,9 @@ def _common_stats(ws, acc, cfg):
 def _mf_create(ws, cfg, touched, show, click, mf_dim):
     """Lazy mf creation on the post-accumulation show/click
     (optimizer.cuh.h:104-112); rows created this push keep their candidate
-    init (the reference returns right after initialization, :113-127)."""
+    init (the reference returns right after initialization, :113-127).
+    mf_dim may be per-row [N] for dynamic dims (created rows get THEIR
+    slot's width, ≙ CtrDymfAccessor feature_value.h:42)."""
     score = cfg.nonclk_coeff * (show - click) + cfg.clk_coeff * click
     create = touched & (ws["mf_size"] == 0) & \
         (score >= cfg.mf_create_thresholds)
@@ -77,9 +82,24 @@ def _mf_create(ws, cfg, touched, show, click, mf_dim):
     return create, mf_size, mf_touched
 
 
+
+def _dym_dims(cfg, slot, mf_dim):
+    """Per-row mf dims from the merged slot ids via a fused where-chain
+    (NOT a gather — k compares over [N] cost ~nothing; ≙ CtrDymfAccessor
+    resolving dim by slot, ctr_dymf_accessor.h).  None when the config has
+    no dynamic dims."""
+    if not getattr(cfg, "slot_mf_dims", ()):
+        return None
+    dims = jnp.full(slot.shape, mf_dim, jnp.int32)
+    for sid, d in cfg.slot_mf_dims:
+        dims = jnp.where(slot == sid, d, dims)
+    return dims
+
+
 def sparse_adagrad_apply(ws: Dict[str, jnp.ndarray],
                          acc: Dict[str, jnp.ndarray],
-                         cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
+                         cfg: SparseSGDConfig,
+                         dims_row=None) -> Dict[str, jnp.ndarray]:
     """One merged push → working-set update (≙ HashTable::update with
     SparseAdagradOptimizer, hashtable_kernel.cu + optimizer.cuh.h:31)."""
     touched, show, click, delta = _common_stats(ws, acc, cfg)
@@ -101,12 +121,15 @@ def sparse_adagrad_apply(ws: Dict[str, jnp.ndarray],
     # lazy mf creation on the *post-accumulation* show/click
     # (optimizer.cuh.h:104-112)
     mf_dim = ws["mf"].shape[1]
+    if dims_row is None:
+        dims_row = _dym_dims(cfg, slot, mf_dim)
+    group_dim = dims_row if dims_row is not None else mf_dim
     create, mf_size, mf_touched = _mf_create(ws, cfg, touched, show, click,
-                                             mf_dim)
+                                             group_dim)
     mf, mf_g2sum = _adagrad_update(
         ws["mf"], ws["mf_g2sum"], acc["g_embedx"], acc["g_show"],
         cfg.mf_learning_rate, cfg.mf_initial_g2sum, cfg.mf_min_bound,
-        cfg.mf_max_bound, mf_touched, mf_dim)
+        cfg.mf_max_bound, mf_touched, group_dim)
 
     out = {"show": show, "click": click, "delta_score": delta, "slot": slot,
            "embed_w": embed_w, "embed_g2sum": embed_g2sum,
@@ -134,13 +157,24 @@ def _shared_adam_group(w, m1, m2, b1p, b2p, g, scale, lr, beta1, beta2,
     means and the beta powers decay once."""
     safe_scale = jnp.where(scale > 0, scale, 1.0)
     ratio = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    per_row_dim = getattr(n_dim, "ndim", 0) > 0
     if w.ndim == 2:
         sg = g / safe_scale[:, None]
         new_m1 = beta1 * m1[:, None] + (1 - beta1) * sg
         new_m2 = beta2 * m2[:, None] + (1 - beta2) * sg * sg
-        new_w = w + ratio[:, None] * (new_m1 / (jnp.sqrt(new_m2) + eps))
-        m1_out = jnp.mean(new_m1, axis=1)
-        m2_out = jnp.mean(new_m2, axis=1)
+        upd = new_m1 / (jnp.sqrt(new_m2) + eps)
+        if per_row_dim:
+            # dynamic mf dims: only the row's true columns update, and the
+            # shared moments are means over those columns alone
+            dmask = (jnp.arange(w.shape[1])[None, :]
+                     < n_dim[:, None]).astype(w.dtype)
+            upd = upd * dmask
+            m1_out = jnp.sum(new_m1 * dmask, axis=1) / n_dim
+            m2_out = jnp.sum(new_m2 * dmask, axis=1) / n_dim
+        else:
+            m1_out = jnp.mean(new_m1, axis=1)
+            m2_out = jnp.mean(new_m2, axis=1)
+        new_w = w + ratio[:, None] * upd
         mask = touched[:, None]
     else:
         sg = g / safe_scale
@@ -158,13 +192,15 @@ def _shared_adam_group(w, m1, m2, b1p, b2p, g, scale, lr, beta1, beta2,
 
 
 def sparse_adam_apply(ws: Dict[str, jnp.ndarray], acc: Dict[str, jnp.ndarray],
-                      cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
+                      cfg: SparseSGDConfig,
+                         dims_row=None) -> Dict[str, jnp.ndarray]:
     """Exact SparseAdamShared (optimizer.cuh.h:330-477): shared per-row
     moments in embed_gsum/embed_g2sum (+ beta powers) for the lr weight and
     mf_gsum/mf_g2sum for the embedx group.  Requires the adam state fields
     (feature_value.ADAM_FIELDS — created when config.sgd.optimizer is
     adam/shared_adam)."""
     touched, show, click, delta = _common_stats(ws, acc, cfg)
+    slot = jnp.where(touched, acc["slot"], ws["slot"])
 
     embed_w, e_m1, e_m2, e_b1, e_b2 = _shared_adam_group(
         ws["embed_w"], ws["embed_gsum"], ws["embed_g2sum"],
@@ -173,13 +209,16 @@ def sparse_adam_apply(ws: Dict[str, jnp.ndarray], acc: Dict[str, jnp.ndarray],
         cfg.mf_min_bound, cfg.mf_max_bound, touched, 1, cfg.ada_epsilon)
 
     mf_dim = ws["mf"].shape[1]
+    if dims_row is None:
+        dims_row = _dym_dims(cfg, slot, mf_dim)
+    group_dim = dims_row if dims_row is not None else mf_dim
     create, mf_size, mf_touched = _mf_create(ws, cfg, touched, show, click,
-                                             mf_dim)
+                                             group_dim)
     mf, m_m1, m_m2, m_b1, m_b2 = _shared_adam_group(
         ws["mf"], ws["mf_gsum"], ws["mf_g2sum"], ws["mf_b1p"], ws["mf_b2p"],
         acc["g_embedx"], acc["g_show"], cfg.mf_learning_rate,
         cfg.beta1_decay_rate, cfg.beta2_decay_rate,
-        cfg.mf_min_bound, cfg.mf_max_bound, mf_touched, mf_dim,
+        cfg.mf_min_bound, cfg.mf_max_bound, mf_touched, group_dim,
         cfg.ada_epsilon)
     # rows created this push reset their beta powers to the decay rates
     # (creation init, optimizer.cuh.h:436-441)
@@ -187,7 +226,7 @@ def sparse_adam_apply(ws: Dict[str, jnp.ndarray], acc: Dict[str, jnp.ndarray],
     m_b2 = jnp.where(create, cfg.beta2_decay_rate, m_b2)
 
     out = {"show": show, "click": click, "delta_score": delta,
-           "slot": jnp.where(touched, acc["slot"], ws["slot"]),
+           "slot": slot,
            "embed_w": embed_w, "embed_g2sum": e_m2, "embed_gsum": e_m1,
            "embed_b1p": e_b1, "embed_b2p": e_b2,
            "mf_size": mf_size, "mf_g2sum": m_m2, "mf_gsum": m_m1,
@@ -200,10 +239,12 @@ def sparse_adam_apply(ws: Dict[str, jnp.ndarray], acc: Dict[str, jnp.ndarray],
 
 def sparse_naive_apply(ws: Dict[str, jnp.ndarray],
                        acc: Dict[str, jnp.ndarray],
-                       cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
+                       cfg: SparseSGDConfig,
+                         dims_row=None) -> Dict[str, jnp.ndarray]:
     """SparseNaiveSGDRule (sparse_sgd_rule.h:77): plain SGD with bound
     clipping, show-scaled grads; g2sum fields unused."""
     touched, show, click, delta = _common_stats(ws, acc, cfg)
+    slot = jnp.where(touched, acc["slot"], ws["slot"])
     safe_scale = jnp.where(acc["g_show"] > 0, acc["g_show"], 1.0)
     embed_w = jnp.where(
         touched,
@@ -211,8 +252,11 @@ def sparse_naive_apply(ws: Dict[str, jnp.ndarray],
                  acc["g_embed"] / safe_scale, cfg.min_bound, cfg.max_bound),
         ws["embed_w"])
     mf_dim = ws["mf"].shape[1]
+    if dims_row is None:
+        dims_row = _dym_dims(cfg, slot, mf_dim)
+    group_dim = dims_row if dims_row is not None else mf_dim
     create, mf_size, mf_touched = _mf_create(ws, cfg, touched, show, click,
-                                             mf_dim)
+                                             group_dim)
     mf = jnp.where(
         mf_touched[:, None],
         jnp.clip(ws["mf"] + cfg.mf_learning_rate *
@@ -220,7 +264,7 @@ def sparse_naive_apply(ws: Dict[str, jnp.ndarray],
                  cfg.mf_min_bound, cfg.mf_max_bound),
         ws["mf"])
     out = {"show": show, "click": click, "delta_score": delta,
-           "slot": jnp.where(touched, acc["slot"], ws["slot"]),
+           "slot": slot,
            "embed_w": embed_w, "embed_g2sum": ws["embed_g2sum"],
            "mf_size": mf_size, "mf_g2sum": ws["mf_g2sum"], "mf": mf}
     for extra in ("mf_ex", "mf_ex_g2sum"):
@@ -231,7 +275,8 @@ def sparse_naive_apply(ws: Dict[str, jnp.ndarray],
 
 def sparse_std_adagrad_apply(ws: Dict[str, jnp.ndarray],
                              acc: Dict[str, jnp.ndarray],
-                             cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
+                             cfg: SparseSGDConfig,
+                         dims_row=None) -> Dict[str, jnp.ndarray]:
     """StdAdaGradSGDRule (sparse_sgd_rule.h:109, UpdateValueWork in
     sparse_sgd_rule.cc): adagrad with a *per-dimension* g2sum for the embedx
     group (field mf_g2sum_d [N, D]) instead of the shared per-row scalar.
@@ -252,8 +297,11 @@ def sparse_std_adagrad_apply(ws: Dict[str, jnp.ndarray],
                             ws["embed_g2sum"])
 
     mf_dim = ws["mf"].shape[1]
+    if dims_row is None:
+        dims_row = _dym_dims(cfg, slot, mf_dim)
+    group_dim = dims_row if dims_row is not None else mf_dim
     create, mf_size, mf_touched = _mf_create(ws, cfg, touched, show, click,
-                                             mf_dim)
+                                             group_dim)
     sg_mf = acc["g_embedx"] / safe_scale[:, None]             # [N, D]
     ratio_d = cfg.mf_learning_rate * jnp.sqrt(
         cfg.mf_initial_g2sum / (cfg.mf_initial_g2sum + ws["mf_g2sum_d"]))
@@ -278,7 +326,8 @@ def sparse_std_adagrad_apply(ws: Dict[str, jnp.ndarray],
 
 def sparse_adam_dim_apply(ws: Dict[str, jnp.ndarray],
                           acc: Dict[str, jnp.ndarray],
-                          cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
+                          cfg: SparseSGDConfig,
+                         dims_row=None) -> Dict[str, jnp.ndarray]:
     """Per-dimension SparseAdam (CPU SparseAdamSGDRule sparse_sgd_rule.h:126
     / GPU SparseAdamOptimizer optimizer.cuh.h:148): embedx keeps full [N, D]
     first/second moments (mf_gsum_d / mf_g2sum_d) with shared scalar
@@ -287,6 +336,7 @@ def sparse_adam_dim_apply(ws: Dict[str, jnp.ndarray],
     eps = cfg.ada_epsilon
     b1, b2 = cfg.beta1_decay_rate, cfg.beta2_decay_rate
     touched, show, click, delta = _common_stats(ws, acc, cfg)
+    slot = jnp.where(touched, acc["slot"], ws["slot"])
     safe_scale = jnp.where(acc["g_show"] > 0, acc["g_show"], 1.0)
 
     embed_w, e_m1, e_m2, e_b1, e_b2 = _shared_adam_group(
@@ -296,8 +346,11 @@ def sparse_adam_dim_apply(ws: Dict[str, jnp.ndarray],
         touched, 1, eps)
 
     mf_dim = ws["mf"].shape[1]
+    if dims_row is None:
+        dims_row = _dym_dims(cfg, slot, mf_dim)
+    group_dim = dims_row if dims_row is not None else mf_dim
     create, mf_size, mf_touched = _mf_create(ws, cfg, touched, show, click,
-                                             mf_dim)
+                                             group_dim)
 
     sg = acc["g_embedx"] / safe_scale[:, None]                # [N, D]
     new_m1 = b1 * ws["mf_gsum_d"] + (1 - b1) * sg
@@ -319,7 +372,7 @@ def sparse_adam_dim_apply(ws: Dict[str, jnp.ndarray],
     mf_b2p = jnp.where(create, b2, mf_b2p)
 
     out = {"show": show, "click": click, "delta_score": delta,
-           "slot": jnp.where(touched, acc["slot"], ws["slot"]),
+           "slot": slot,
            "embed_w": embed_w, "embed_gsum": e_m1, "embed_g2sum": e_m2,
            "embed_b1p": e_b1, "embed_b2p": e_b2,
            "mf_size": mf_size, "mf": mf,
@@ -341,5 +394,7 @@ OPTIMIZERS = {
 }
 
 
-def apply_push(ws, acc, cfg: SparseSGDConfig):
-    return OPTIMIZERS[cfg.optimizer](ws, acc, cfg)
+def apply_push(ws, acc, cfg: SparseSGDConfig, dims_row=None):
+    """dims_row: optional per-row [N] mf dims (dynamic-dim accessor,
+    ≙ CtrDymfAccessor) — rules divide/mask by the row's true width."""
+    return OPTIMIZERS[cfg.optimizer](ws, acc, cfg, dims_row)

@@ -169,7 +169,10 @@ class SSDTieredTable:
         the rest (≙ the `_cache_tk_size` top-k cache-threshold policy,
         ssd_sparse_table.h:82: the threshold is the k-th score, computed
         over the whole table, not a fixed constant)."""
-        scores = [self.host._score(s.soa) for s in self.host._shards]
+        scores = []
+        for s in self.host._shards:
+            with s.lock:   # a concurrent upsert replaces soa field arrays
+                scores.append(np.array(self.host._score(s.soa)))
         all_scores = np.concatenate(scores) if scores else np.empty((0,))
         if len(all_scores) <= cache_rows:
             return 0

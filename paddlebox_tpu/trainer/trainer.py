@@ -66,6 +66,18 @@ class SparseTrainer:
         self.slot_ids = np.array(
             [s.slot_id for s in feed_config.sparse_slots], np.int32)
 
+        # dynamic per-slot mf dims (≙ CtrDymfAccessor): mask [S, 3+D] that
+        # zeroes each slot's unused tail columns in the pooled features —
+        # gradients through the mask zero themselves, so push/optimizer see
+        # exact-zero tail grads with no extra work in the hot loop
+        self._dym_mask = None
+        if engine.config.sgd.slot_mf_dims:
+            d_max = engine.config.embedding_dim
+            m = np.ones((len(self.slot_ids), 3 + d_max), np.float32)
+            for i, sid in enumerate(self.slot_ids):
+                m[i, 3 + engine.config.slot_mf_dim(int(sid)):] = 0.0
+            self._dym_mask = jnp.asarray(m)
+
         self.dense_tx = dense_optimizer or optax.adam(1e-3)
         self.params = model.init(jax.random.PRNGKey(seed))
         self.opt_state = self.dense_tx.init(self.params)
@@ -201,6 +213,7 @@ class SparseTrainer:
         model = self.model
         dense_tx = self.dense_tx
         amp = self.amp
+        dym_mask = self._dym_mask
 
         apply_dense = self.async_dense is None
 
@@ -208,6 +221,8 @@ class SparseTrainer:
             B = pooled.shape[0]
 
             def loss_fn(p, pooled_in):
+                if dym_mask is not None:
+                    pooled_in = pooled_in * dym_mask[None]
                 x = pooled_in if use_cvm else pooled_in[:, :, 2:]
                 x = x.reshape(B, -1)
                 if amp:
@@ -377,6 +392,7 @@ class SparseTrainer:
             return core
 
         model, dense_tx, amp = self.model, self.dense_tx, self.amp
+        dym_mask = self._dym_mask
 
         def core(ws, params, opt_state, auc_state, idx_slb, lengths, dense,
                  labels, valid, plan):
@@ -388,6 +404,11 @@ class SparseTrainer:
             # 2-3. forward + backward over (dense params, pulled embeddings)
             def loss_fn(p, e):
                 pooled = fused_seqpool_cvm(e, lengths, ins_cvm, use_cvm)
+                if dym_mask is not None:
+                    # fused_seqpool_cvm emits [B, S*E] flattened; with
+                    # use_cvm=False the 2 cvm columns are dropped first
+                    m = dym_mask if use_cvm else dym_mask[:, 2:]
+                    pooled = pooled * m.reshape(-1)[None]
                 if amp:
                     # bf16 compute, f32 master weights (strategy.amp —
                     # ≙ fleet amp meta-optimizer; MXU runs 2x+ in bf16)

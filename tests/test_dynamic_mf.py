@@ -1,0 +1,157 @@
+"""Dynamic per-slot mf dims — the CtrDymfAccessor equivalent
+(ctr_dymf_accessor.h + feature_value.h:42).
+
+TPU-first contract: storage stays at embedding_dim; a narrow slot trains
+and pulls only its first d columns.  Verified here end-to-end: the tail
+columns never train, created rows record their slot's true dim, the
+optimizer divides by the true dim, and the mxu / fast / reference paths
+agree under the dynamic config.
+"""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import (DataFeedConfig, EmbeddingTableConfig,
+                                  SlotConfig, SparseSGDConfig)
+from paddlebox_tpu.data.dataset import SlotDataset
+from paddlebox_tpu.data.slot_record import SlotRecordBlock
+from paddlebox_tpu.models.deepfm import DeepFM
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.trainer.trainer import SparseTrainer
+
+MF = 4
+NARROW = 2
+N_SLOTS = 3
+WIDE_SLOT, NARROW_SLOT = 101, 102
+
+
+def _feed_config():
+    return DataFeedConfig(slots=(
+        SlotConfig("label", dtype="float", is_dense=True, dim=1),
+        SlotConfig("dense0", dtype="float", is_dense=True, dim=2),
+        SlotConfig("sa", slot_id=WIDE_SLOT, capacity=2),
+        SlotConfig("sb", slot_id=NARROW_SLOT, capacity=2),
+        SlotConfig("sc", slot_id=103, capacity=1),
+    ))
+
+
+def _blocks(seed=0, n=256):
+    rng = np.random.default_rng(seed)
+    blk = SlotRecordBlock(n=n)
+    # DISJOINT key ranges per slot so each row has one unambiguous slot
+    for i, name in enumerate(("sa", "sb", "sc")):
+        cap = 2 if name != "sc" else 1
+        lens = rng.integers(1, cap + 1, size=n)
+        off = np.zeros((n + 1,), np.int64)
+        np.cumsum(lens, out=off[1:])
+        blk.uint64_slots[name] = (
+            (rng.integers(1, 80, size=int(off[-1]))
+             + 1000 * (i + 1)).astype(np.uint64), off)
+    blk.float_slots["label"] = (
+        rng.integers(0, 2, size=n).astype(np.float32),
+        np.arange(n + 1, dtype=np.int64))
+    blk.float_slots["dense0"] = (
+        rng.normal(0, 1, size=n * 2).astype(np.float32),
+        np.arange(n + 1, dtype=np.int64) * 2)
+    return [blk]
+
+
+def _train(blocks, sparse_path, optimizer="adagrad", dym=True, passes=4):
+    cfg = _feed_config()
+    ds = SlotDataset(cfg)
+    ds._blocks = blocks
+    sgd = SparseSGDConfig(
+        optimizer=optimizer, mf_create_thresholds=0.0,
+        slot_mf_dims=(((NARROW_SLOT, NARROW),) if dym else ()))
+    eng = BoxPSEngine(EmbeddingTableConfig(embedding_dim=MF, sgd=sgd))
+    model = DeepFM(num_slots=N_SLOTS, emb_width=3 + MF, dense_dim=2,
+                   hidden=(16,))
+    stats = None
+    tr = SparseTrainer(eng, model, cfg, batch_size=64, seed=0,
+                       sparse_path=sparse_path)
+    for _ in range(passes):
+        eng.begin_feed_pass()
+        for b in ds.get_blocks():
+            eng.add_keys(b.all_keys())
+        eng.end_feed_pass()
+        eng.begin_pass()
+        stats = tr.train_pass(ds)
+        eng.end_pass()
+    return stats, eng, tr
+
+
+def _trained_rows(eng):
+    """All rows the last pass wrote back, read from the host table."""
+    keys = eng._last_written
+    return keys, eng.table.bulk_pull(keys)
+
+
+@pytest.mark.parametrize("sparse_path", ["reference", "mxu", "fast"])
+def test_narrow_slot_tail_never_trains(sparse_path):
+    stats, eng, tr = _train(_blocks(), sparse_path)
+    assert stats["batches"] == 4
+    keys, rows = _trained_rows(eng)
+    slot = np.asarray(rows["slot"])
+    mf = np.asarray(rows["mf"])
+    mf_size = np.asarray(rows["mf_size"])
+    narrow = slot == NARROW_SLOT
+    wide = slot == WIDE_SLOT
+    assert narrow.any() and wide.any()
+    # created narrow rows record their true dim; wide rows the full dim
+    assert np.all(mf_size[narrow & (mf_size > 0)] == NARROW)
+    assert np.all(mf_size[wide & (mf_size > 0)] == MF)
+    # tail columns of narrow rows keep their creation-candidate values —
+    # training never touches them (grads masked to exact zero)
+    candidate_max = eng.config.sgd.mf_initial_range
+    tail = mf[narrow][:, NARROW:]
+    assert np.all((tail >= 0.0) & (tail <= candidate_max + 1e-7)), \
+        tail[np.abs(tail) > candidate_max][:5]
+    # wide rows' tail DID train (moved beyond the candidate range)
+    assert np.abs(mf[wide][:, NARROW:]).max() > candidate_max * 10
+
+
+def test_paths_agree_under_dynamic_dims():
+    s_ref, e_ref, _ = _train(_blocks(), "reference")
+    s_mxu, e_mxu, _ = _train(_blocks(), "mxu")
+    assert np.isclose(s_ref["loss"], s_mxu["loss"], atol=1e-4)
+    k_ref, r_ref = _trained_rows(e_ref)
+    k_mxu, r_mxu = _trained_rows(e_mxu)
+    np.testing.assert_array_equal(k_ref, k_mxu)
+    for f in ("mf", "mf_g2sum", "mf_size", "embed_w", "show"):
+        np.testing.assert_allclose(np.asarray(r_ref[f]),
+                                   np.asarray(r_mxu[f]), atol=1e-5,
+                                   err_msg=f)
+
+
+def test_g2sum_divides_by_true_dim():
+    """The adagrad mean-square uses the row's true dim: a narrow slot with
+    the same per-column grads must accumulate the same g2sum as a wide
+    slot would over its own width — not a D_max-diluted one."""
+    import jax.numpy as jnp
+    from paddlebox_tpu.ps import optimizer as opt
+    sgd = SparseSGDConfig(mf_create_thresholds=0.0,
+                          slot_mf_dims=((NARROW_SLOT, NARROW),))
+    n = 4
+    ws = {
+        "show": jnp.zeros(n), "click": jnp.zeros(n),
+        "delta_score": jnp.zeros(n),
+        "slot": jnp.asarray([0, WIDE_SLOT, NARROW_SLOT, NARROW_SLOT],
+                            jnp.int32),
+        "embed_w": jnp.zeros(n), "embed_g2sum": jnp.zeros(n),
+        "mf_size": jnp.asarray([0, MF, NARROW, NARROW], jnp.int32),
+        "mf_g2sum": jnp.zeros(n), "mf": jnp.zeros((n, MF)),
+    }
+    g = np.zeros((n, MF), np.float32)
+    g[1] = [1, 1, 1, 1]          # wide: mean sq = 1
+    g[2] = [1, 1, 0, 0]          # narrow: per-col grad 1 over dim 2
+    acc = {
+        "g_show": jnp.asarray([0, 1, 1, 1], jnp.float32),
+        "g_click": jnp.zeros(n), "g_embed": jnp.zeros(n),
+        "g_embedx": jnp.asarray(g),
+        "slot": ws["slot"],
+    }
+    out = opt.apply_push(ws, acc, sgd)
+    g2 = np.asarray(out["mf_g2sum"])
+    assert np.isclose(g2[1], 1.0)          # 4/4
+    assert np.isclose(g2[2], 1.0), g2      # 2/2 — not 2/4
+    assert np.isclose(g2[3], 0.0)
