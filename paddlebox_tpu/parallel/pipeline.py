@@ -20,9 +20,55 @@ from __future__ import annotations
 
 from typing import Callable, List, Sequence
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def _f1b_tick(pp, s, M, cap, axis, params, fwd_fn, feed_of, loss_and_dy,
+              carry, t):
+    """One 1F1B tick, shared by the homogeneous and heterogeneous runners:
+    stage s forwards microbatch t - s and backwards t - (2*pp - 2 - s),
+    recomputing the forward from the stashed INPUT (recompute-in-backward),
+    with activations hopping +1 and gradients -1 over the pp ring.
+
+    fwd_fn(params, x) -> y on the runner's activation representation;
+    feed_of(m) -> stage-0 input for microbatch m; loss_and_dy(y, m) ->
+    (loss scalar, dL/dy) for the last stage.
+    """
+    perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+    perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
+    y_send, g_send, stash, g_acc, loss_acc = carry
+    x_in = lax.ppermute(y_send, axis, perm_fwd)
+    g_in = lax.ppermute(g_send, axis, perm_bwd)
+
+    m_f = t - s
+    m_b = t - (2 * pp - 2 - s)
+    do_f = (m_f >= 0) & (m_f < M)
+    do_b = (m_b >= 0) & (m_b < M)
+
+    # ---- forward of microbatch m_f ----------------------------------------
+    x_f = jnp.where(s == 0, feed_of(jnp.clip(m_f, 0, M - 1)), x_in)
+    y_f = fwd_fn(params, x_f)
+    y_send_new = jnp.where(do_f, y_f, y_send)
+    slot_f = jnp.clip(m_f, 0, M - 1) % cap
+    stash = lax.dynamic_update_index_in_dim(
+        stash, jnp.where(do_f, x_f, stash[slot_f]), slot_f, 0)
+
+    # ---- backward of m_b (recompute from stashed input) -------------------
+    mb_c = jnp.clip(m_b, 0, M - 1)
+    x_b = stash[mb_c % cap]
+    y_b, pull = jax.vjp(fwd_fn, params, x_b)
+    loss_val, dy_last = loss_and_dy(y_b, mb_c)
+    dy = jnp.where(s == pp - 1, dy_last, g_in)
+    d_params, d_x = pull(dy)
+    g_acc = jax.tree.map(
+        lambda a, d: a + jnp.where(do_b, d, jnp.zeros_like(d)),
+        g_acc, d_params)
+    g_send_new = jnp.where(do_b, d_x, g_send)
+    loss_acc = loss_acc + jnp.where(do_b & (s == pp - 1), loss_val, 0.0)
+    return (y_send_new, g_send_new, stash, g_acc, loss_acc), None
 
 
 class PipelineRunner:
@@ -106,59 +152,136 @@ class PipelineRunner1F1B:
         M = microbatches.shape[0]
         ticks = M + 2 * pp - 2
         cap = 2 * pp                          # stash slots (≥ max in-flight)
-        perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
-        perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
         params = jax.tree.map(lambda a: a[0], params_local)
 
         x_shape = microbatches[0]
         stash0 = jnp.zeros((cap,) + x_shape.shape, x_shape.dtype)
         g_acc0 = jax.tree.map(jnp.zeros_like, params)
 
+        def loss_and_dy(y_b, mb_c):
+            return jax.value_and_grad(self.loss_fn)(y_b, targets[mb_c])
+
         def tick(carry, t):
-            y_send, g_send, stash, g_acc, loss_acc = carry
-            x_in = lax.ppermute(y_send, axis, perm_fwd)
-            g_in = lax.ppermute(g_send, axis, perm_bwd)
-
-            m_f = t - s
-            m_b = t - (2 * pp - 2 - s)
-            do_f = (m_f >= 0) & (m_f < M)
-            do_b = (m_b >= 0) & (m_b < M)
-
-            # ---- forward of microbatch m_f --------------------------------
-            feed = microbatches[jnp.clip(m_f, 0, M - 1)]
-            x_f = jnp.where(s == 0, feed, x_in)
-            y_f = self.stage_fn(params, x_f)
-            y_send_new = jnp.where(do_f, y_f, y_send)
-            stash = lax.dynamic_update_index_in_dim(
-                stash, jnp.where(do_f, x_f, stash[jnp.clip(m_f, 0, M - 1)
-                                                  % cap]),
-                jnp.clip(m_f, 0, M - 1) % cap, 0)
-
-            # ---- backward of microbatch m_b (recompute from stashed x) ----
-            mb_c = jnp.clip(m_b, 0, M - 1)
-            x_b = stash[mb_c % cap]
-            y_b, pull = jax.vjp(self.stage_fn, params, x_b)
-            tgt = targets[mb_c]
-            loss_val, dy_last = jax.value_and_grad(self.loss_fn)(y_b, tgt)
-            dy = jnp.where(s == pp - 1, dy_last, g_in)
-            d_params, d_x = pull(dy)
-            g_acc = jax.tree.map(
-                lambda a, d: a + jnp.where(do_b, d, jnp.zeros_like(d)),
-                g_acc, d_params)
-            g_send_new = jnp.where(do_b, d_x, g_send)
-            loss_acc = loss_acc + jnp.where(
-                do_b & (s == pp - 1), loss_val, 0.0)
-
-            return (y_send_new, g_send_new, stash, g_acc, loss_acc), None
+            return _f1b_tick(pp, s, M, cap, axis, params, self.stage_fn,
+                             lambda m: microbatches[m], loss_and_dy,
+                             carry, t)
 
         init = (jnp.zeros_like(x_shape), jnp.zeros_like(x_shape), stash0,
                 g_acc0, jnp.float32(0.0))
-        (y_send, g_send, stash, g_acc, loss_acc), _ = lax.scan(
-            tick, init, jnp.arange(ticks))
+        (_, _, _, g_acc, loss_acc), _ = lax.scan(tick, init,
+                                                 jnp.arange(ticks))
         # loss lives on the last stage; replicate it
         loss = lax.psum(jnp.where(s == pp - 1, loss_acc, 0.0), axis) / M
         grads = jax.tree.map(lambda a: a[None] / M, g_acc)
         return loss, grads
+
+
+class HeteroPipeline1F1B:
+    """1F1B over HETEROGENEOUS stages — per-stage functions, param pytrees
+    and activation shapes (≙ SectionWorker's per-section programs +
+    schedule_mode=1, section_worker.cc:149-213, where each section runs its
+    own sub-program; the reference's stages are arbitrary program slices,
+    not copies of one block).
+
+    TPU-first formulation: XLA has no MPMD inside one jit, so every device
+    runs the SAME scan and selects its stage body with ``lax.switch``;
+    activations cross stages through a fixed-size flattened pad buffer
+    (ppermute needs one static shape), and each branch un/re-flattens its
+    own signature.  Params travel as a tuple of per-stage pytrees; a device
+    produces gradients only for the branch it executes, and one psum at the
+    end assembles the full grad tree.  The stash is bounded at 2*pp
+    microbatch INPUTS (recompute-in-backward) — constant in M, the 1F1B
+    memory contract.
+
+    Note on memory: params are replicated across pp devices here (shapes
+    differ per stage, so they cannot shard as one stacked array).  For
+    memory-bound homogeneous pipelines use PipelineRunner1F1B, which shards
+    the stacked params over pp.
+    """
+
+    def __init__(self, stage_fns: Sequence[Callable],
+                 io_shapes: Sequence[tuple], loss_fn: Callable,
+                 axis: str = "pp"):
+        """stage_fns[s](params_s, x_s) -> y_s; io_shapes is the chain
+        [shape_0, shape_1, ..., shape_pp] with shape_s = stage s's input
+        microbatch shape and shape_pp the final output shape."""
+        self.stage_fns = list(stage_fns)
+        self.io_shapes = [tuple(s) for s in io_shapes]
+        self.loss_fn = loss_fn
+        self.axis = axis
+        self.n_stages = len(self.stage_fns)
+        assert len(self.io_shapes) == self.n_stages + 1
+        self._sizes = [int(np.prod(s)) for s in self.io_shapes]
+        self.buf_len = max(self._sizes)
+
+    # -- pad-buffer plumbing ------------------------------------------------
+    def _unflatten(self, buf, shape):
+        return buf[: int(np.prod(shape))].reshape(shape)
+
+    def _flatten(self, y):
+        flat = y.reshape(-1)
+        return jnp.concatenate(
+            [flat, jnp.zeros((self.buf_len - flat.shape[0],), flat.dtype)])
+
+    def _fwd(self, s, params_all, x_buf):
+        """switch over stage bodies: buf -> buf."""
+        branches = []
+        for i, fn in enumerate(self.stage_fns):
+            def branch(args, i=i, fn=fn):
+                p_all, buf = args
+                x = self._unflatten(buf, self.io_shapes[i])
+                return self._flatten(fn(p_all[i], x))
+            branches.append(branch)
+        return lax.switch(s, branches, (params_all, x_buf))
+
+    def __call__(self, params_all, microbatches: jnp.ndarray,
+                 targets: jnp.ndarray):
+        """Inside shard_map.  params_all: tuple of per-stage pytrees
+        (replicated); microbatches [M, *io_shapes[0]]; targets [M, ...].
+        → (mean loss, full grad tuple — replicated)."""
+        pp, axis = self.n_stages, self.axis
+        s = lax.axis_index(axis)
+        M = microbatches.shape[0]
+        ticks = M + 2 * pp - 2
+        cap = 2 * pp   # 1F1B in-flight bound: constant in M
+        out_shape = self.io_shapes[-1]
+        loss_fn = self.loss_fn
+        dtype = microbatches.dtype   # buffers follow the activation dtype
+
+        def fwd_fn(p_all, x_buf):
+            return self._fwd(s, p_all, x_buf)
+
+        stash0 = jnp.zeros((cap, self.buf_len), dtype)
+        g_acc0 = jax.tree.map(jnp.zeros_like, params_all)
+        zero_buf = jnp.zeros((self.buf_len,), dtype)
+
+        def loss_and_dy(y_b, mb_c):
+            def loss_of_buf(yb):
+                return loss_fn(self._unflatten(yb, out_shape),
+                               targets[mb_c])
+            return jax.value_and_grad(loss_of_buf)(y_b)
+
+        def tick(carry, t):
+            return _f1b_tick(
+                pp, s, M, cap, axis, params_all, fwd_fn,
+                lambda m: self._flatten(microbatches[m]), loss_and_dy,
+                carry, t)
+
+        init = (zero_buf, zero_buf, stash0, g_acc0, jnp.float32(0.0))
+        (_, _, _, g_acc, loss_acc), _ = lax.scan(tick, init,
+                                                 jnp.arange(ticks))
+        # each device holds grads for ITS stage only; one psum assembles
+        # the full tuple everywhere (≙ the section programs' param grads
+        # living on their own devices — replication is the SPMD cost)
+        grads = jax.tree.map(lambda a: lax.psum(a, axis) / M, g_acc)
+        loss = lax.psum(jnp.where(s == pp - 1, loss_acc, 0.0), axis) / M
+        return loss, grads
+
+    @property
+    def stash_slots(self) -> int:
+        """In-flight activation bound: 2*pp microbatch inputs, independent
+        of M (the 1F1B memory contract vs GPipe's O(M))."""
+        return 2 * self.n_stages
 
 
 def stack_stage_params(per_stage_params: Sequence) -> object:
