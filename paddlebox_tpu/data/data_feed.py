@@ -105,7 +105,21 @@ class SlotParser:
 
 
 def open_file(path: str, pipe_command: str = "") -> io.TextIOBase:
-    """≙ fs_open_read (framework/io/fs.cc): optional shell pipe, gz support."""
+    """≙ fs_open_read (framework/io/fs.cc): optional shell pipe, gz
+    support, and scheme-dispatched remote filesystems (hdfs://... through
+    the registered ShellFS — paddlebox_tpu/io/fs.py)."""
+    from paddlebox_tpu.io import fs as pfs
+    scheme, _ = pfs.split_scheme(path)
+    if scheme and scheme != "file":
+        if pipe_command:
+            raise ValueError(
+                "pipe_command over a remote path is not supported — "
+                "preprocess into the remote store or read locally")
+        raw = io.BufferedReader(pfs.open_read(path))
+        if path.endswith(".gz"):
+            import gzip
+            return io.TextIOWrapper(gzip.GzipFile(fileobj=raw))
+        return io.TextIOWrapper(raw)
     if pipe_command:
         cmd = f"cat '{path}' | {pipe_command}" if path else pipe_command
         proc = subprocess.Popen(cmd, shell=True, stdout=subprocess.PIPE)

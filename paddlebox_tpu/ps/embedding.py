@@ -131,8 +131,42 @@ def build_working_set(host_soa: Dict[str, np.ndarray], mf_dim: int,
 def dump_working_set(ws: Dict[str, jnp.ndarray], n: int
                      ) -> Dict[str, np.ndarray]:
     """Device→host for end_pass write-back (≙ dump_pool_to_cpu_func,
-    ps_gpu_wrapper.cc:983+ / accessor DumpFill)."""
-    return {f: np.asarray(ws[f])[1:n + 1] for f in ws}
+    ps_gpu_wrapper.cc:983+ / accessor DumpFill).  Table-wide scalars
+    (e.g. a serving freeze's mf_scale) are not row data and are skipped."""
+    return {f: np.asarray(ws[f])[1:n + 1] for f in ws
+            if getattr(ws[f], "ndim", 1) >= 1}
+
+
+def quantize_working_set(ws: Dict[str, jnp.ndarray], quant_bits: int = 16,
+                         scale: float = 1.0 / 32767.0
+                         ) -> Dict[str, jnp.ndarray]:
+    """Serving-mode freeze: re-encode mf as int16 grid points so embedx
+    pulls read half the HBM bytes and the table holds half the memory
+    (≙ the quant feature value + EmbedxQuantOp dequant-on-pull,
+    box_wrapper.cu:37-44, table-wide pull_embedx_scale box_wrapper.h:655).
+
+    The quantized working set is PULL-ONLY — pushes require the f32 store
+    (the reference likewise quantizes only dumped/serving tables)."""
+    if quant_bits != 16:
+        raise ValueError("only quant_bits=16 (int16 grid) is supported")
+    out = dict(ws)
+    q = jnp.clip(jnp.round(ws["mf"] / scale), -32767, 32767)
+    out["mf"] = q.astype(jnp.int16)
+    out["mf_scale"] = jnp.float32(scale)
+    return out
+
+
+def mf_values(ws: Dict[str, jnp.ndarray], gathered: jnp.ndarray
+              ) -> jnp.ndarray:
+    """Dequantize gathered mf rows when the working set is frozen int16
+    (EmbedxQuantOp: dest = int16 * scale); identity for the f32 store."""
+    if jnp.issubdtype(gathered.dtype, jnp.integer) and "mf_scale" in ws:
+        return gathered.astype(jnp.float32) * ws["mf_scale"]
+    return gathered
+
+
+def is_quantized(ws: Dict[str, jnp.ndarray]) -> bool:
+    return "mf_scale" in ws
 
 
 def pull_sparse(ws: Dict[str, jnp.ndarray], indices: jnp.ndarray
@@ -141,13 +175,15 @@ def pull_sparse(ws: Dict[str, jnp.ndarray], indices: jnp.ndarray
 
     ≙ PullSparseCaseGPU + CopyForPull (box_wrapper_impl.h:25,
     box_wrapper.cu:945).  mf is masked until created (mf_size>0 —
-    CommonPullValue semantics, feature_value.h:161).
+    CommonPullValue semantics, feature_value.h:161); a serving-frozen
+    int16 table dequantizes after the gather (half the gather bytes,
+    ≙ EmbedxQuantOp).
     """
     show = ws["show"][indices]
     click = ws["click"][indices]
     embed_w = ws["embed_w"][indices]
-    created = (ws["mf_size"][indices] > 0).astype(ws["mf"].dtype)
-    mf = ws["mf"][indices] * created[..., None]
+    created = (ws["mf_size"][indices] > 0).astype(jnp.float32)
+    mf = mf_values(ws, ws["mf"][indices]) * created[..., None]
     return jnp.concatenate(
         [show[..., None], click[..., None], embed_w[..., None], mf], axis=-1)
 

@@ -45,7 +45,9 @@ def pull_pool_cvm(ws: Dict[str, jnp.ndarray], idx: jnp.ndarray,
     click = jnp.sum(ws["click"][idx] * m, axis=1)
     w = jnp.sum(ws["embed_w"][idx] * m, axis=1)
     created = (ws["mf_size"][idx] > 0).astype(m.dtype) * m
-    mf = jnp.einsum("slbd,slb->sbd", ws["mf"][idx], created)  # [S, B, D]
+    from paddlebox_tpu.ps.embedding import mf_values
+    mf_rows = mf_values(ws, ws["mf"][idx])  # dequant if serving-frozen
+    mf = jnp.einsum("slbd,slb->sbd", mf_rows, created)     # [S, B, D]
     if use_cvm:
         show_t = jnp.log(show + 1.0)
         click_t = jnp.log(click + 1.0) - show_t

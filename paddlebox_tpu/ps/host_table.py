@@ -204,7 +204,12 @@ class ShardedHostTable:
     # -- persistence (≙ SaveBase/SaveDelta box_wrapper.cc:1286; per-shard
     #    files with .shard suffix, memory_sparse_table.h:34) ----------------
     def save(self, path: str, mode: str = "base") -> int:
-        os.makedirs(path, exist_ok=True)
+        """Per-shard npz dumps under `path`, which may be any registered
+        filesystem scheme — e.g. hdfs://... through ShellFS
+        (≙ SaveBase/SaveDelta's AFS paths, box_wrapper.h:721-743)."""
+        from paddlebox_tpu.io import fs as pfs
+        filesystem = pfs.get_fs(path)
+        filesystem.mkdir(path)
         acc = self.config.accessor
         saved = 0
         for i, shard in enumerate(self._shards):
@@ -218,19 +223,28 @@ class ShardedHostTable:
                     keep = np.ones(shard.size, bool)
                 data = {f: arr[keep] for f, arr in shard.soa.items()}
                 data["keys"] = shard.keys[keep]
-                np.savez(os.path.join(path, f"part-{i:05d}.shard.npz"), **data)
+                part = f"{path.rstrip('/')}/part-{i:05d}.shard.npz"
+                with filesystem.open_write(part) as fh:
+                    np.savez(fh, **data)
                 saved += int(keep.sum())
                 if mode == "delta":
                     shard.soa["delta_score"][keep] = 0.0
         return saved
 
     def load(self, path: str) -> int:
+        from io import BytesIO
+
+        from paddlebox_tpu.io import fs as pfs
+        filesystem = pfs.get_fs(path)
         loaded = 0
         for i, shard in enumerate(self._shards):
-            f = os.path.join(path, f"part-{i:05d}.shard.npz")
-            if not os.path.exists(f):
+            f = f"{path.rstrip('/')}/part-{i:05d}.shard.npz"
+            if not filesystem.exists(f):
                 continue
-            with np.load(f) as z:
+            fh = filesystem.open_read(f)
+            # np.load needs seek; only pipe-backed streams buffer fully
+            src = fh if fh.seekable() else BytesIO(fh.read())
+            with np.load(src) as z:
                 with shard.lock:
                     shard.keys = z["keys"]
                     n = len(shard.keys)
@@ -255,5 +269,6 @@ class ShardedHostTable:
                                init_missing(name, tmpl))
                         for name, tmpl in shard.soa.items()}
                     shard.rebuild_index()
+            fh.close()
             loaded += shard.size
         return loaded

@@ -46,13 +46,14 @@ def build_plan(idx_slb: jnp.ndarray, dims: sp.SpmmDims):
 
 def _pull_table(ws: Dict[str, jnp.ndarray], dims: sp.SpmmDims) -> jnp.ndarray:
     """Feature-major pull view [3 + D + 1, n_kernel]."""
+    from paddlebox_tpu.ps.embedding import mf_values
     n = ws["show"].shape[0]
     d = ws["mf"].shape[1]
     tab = jnp.zeros((3 + d + 1, dims.n_kernel), jnp.float32)
     tab = tab.at[0, :n].set(ws["show"])
     tab = tab.at[1, :n].set(ws["click"])
     tab = tab.at[2, :n].set(ws["embed_w"])
-    tab = tab.at[3:3 + d, :n].set(ws["mf"].T)
+    tab = tab.at[3:3 + d, :n].set(mf_values(ws, ws["mf"]).T)
     tab = tab.at[3 + d, :n].set(ws["mf_size"].astype(jnp.float32))
     return tab
 

@@ -200,6 +200,12 @@ class BoxPSEngine:
                  delta_path: str = "") -> None:
         """Write the trained working set back to the DRAM tier."""
         assert self.ws is not None and self.mapper is not None
+        if embedding.is_quantized(self.ws):
+            raise RuntimeError(
+                "serving-frozen working set cannot write back (its embedx "
+                "is an int16 grid, not the f32 store) — a frozen pass ends "
+                "by discarding the device copy (engine.ws = None) or "
+                "rebuilding the pass")
         with self.timers("dump_to_cpu"):
             soa = embedding.dump_working_set(self.ws, self.num_keys)
             soa["unseen_days"] = np.zeros((self.num_keys,), np.float32)
@@ -208,6 +214,16 @@ class BoxPSEngine:
         self._last_written = np.asarray(self.mapper.sorted_keys)
         if need_save_delta and delta_path:
             self.save_delta(delta_path)
+
+    def freeze_for_serving(self, scale: float = 1.0 / 32767.0) -> None:
+        """Re-encode the live working set's embedx as int16 for pull-only
+        serving (≙ loading a quant-feature table + EmbedxQuantOp dequant,
+        box_wrapper.cu:37 / pull_embedx_scale box_wrapper.h:655): embedx
+        pulls read half the bytes, the table holds half the HBM.  Training
+        on a frozen set raises — re-run the pass lifecycle to train."""
+        assert self.ws is not None, "no live working set to freeze"
+        qb = self.config.quant_bits or 16
+        self.ws = embedding.quantize_working_set(self.ws, qb, scale)
 
     # -- persistence ---------------------------------------------------------
     def save_base(self, path: str) -> int:

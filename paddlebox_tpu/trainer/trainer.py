@@ -120,6 +120,11 @@ class SparseTrainer:
         assert self.engine.ws is not None, \
             "engine pass lifecycle must run before building the step " \
             "(begin_feed_pass/add_keys/end_feed_pass/begin_pass)"
+        if embedding.is_quantized(self.engine.ws):
+            raise ValueError(
+                "the working set is serving-frozen (int16 embedx, "
+                "pull-only); training requires the f32 store — rebuild "
+                "the pass (end_feed_pass/begin_pass)")
         path = self.sparse_path
         has_ex = "mf_ex" in self.engine.ws
         is_adagrad = self.engine.config.sgd.optimizer == "adagrad"

@@ -222,3 +222,78 @@ def test_pass_lifecycle_end_to_end():
     idx = eng.mapper(np.array([22, 55], np.uint64))
     got = np.asarray(eng.ws["show"])[idx]
     np.testing.assert_allclose(got, [3., 0.])
+
+
+# -- serving-frozen quantized pulls (EmbedxQuantOp, box_wrapper.cu:37) ------
+
+def test_quantized_serving_pull():
+    import jax.numpy as jnp
+    from paddlebox_tpu.ps import embedding
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+    from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=4, sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    eng.begin_feed_pass()
+    eng.add_keys(np.arange(1, 200, dtype=np.uint64))
+    eng.end_feed_pass()
+    eng.begin_pass()
+    # give mf real values and mark created
+    rng = np.random.default_rng(0)
+    vals = rng.normal(0, 0.01, eng.ws["mf"].shape).astype(np.float32)
+    eng.ws["mf"] = jnp.asarray(vals)
+    eng.ws["mf_size"] = jnp.full_like(eng.ws["mf_size"], 4)
+
+    idx = jnp.asarray(rng.integers(1, 200, (2, 8, 2)).astype(np.int32))
+    full = np.asarray(embedding.pull_sparse(eng.ws, idx))
+
+    scale = 1.0 / 32767.0
+    eng.freeze_for_serving(scale)
+    assert eng.ws["mf"].dtype == jnp.int16          # half the bytes
+    quant = np.asarray(embedding.pull_sparse(eng.ws, idx))
+    # head columns exact, embedx within half a grid step
+    np.testing.assert_array_equal(full[..., :3], quant[..., :3])
+    np.testing.assert_allclose(full[..., 3:], quant[..., 3:],
+                               atol=scale / 2 + 1e-9)
+    assert np.abs(quant[..., 3:]).max() > 0         # values survived
+
+
+def test_frozen_working_set_rejects_training():
+    import pytest as _pytest
+    import jax.numpy as jnp
+    from paddlebox_tpu.config import (DataFeedConfig, EmbeddingTableConfig,
+                                      SlotConfig, SparseSGDConfig)
+    from paddlebox_tpu.models.deepfm import DeepFM
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+    from paddlebox_tpu.trainer.trainer import SparseTrainer
+
+    cfg = DataFeedConfig(slots=(
+        SlotConfig("label", dtype="float", is_dense=True, dim=1),
+        SlotConfig("s0", slot_id=100, capacity=1),
+    ))
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=4, sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    eng.begin_feed_pass()
+    eng.add_keys(np.arange(1, 50, dtype=np.uint64))
+    eng.end_feed_pass()
+    eng.begin_pass()
+    eng.freeze_for_serving()
+    model = DeepFM(num_slots=1, emb_width=7, dense_dim=0, hidden=(8,))
+    tr = SparseTrainer(eng, model, cfg, batch_size=16)
+    with _pytest.raises(ValueError, match="serving-frozen"):
+        tr._resolve_path()
+
+
+def test_frozen_working_set_rejects_end_pass():
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+    from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=4, sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    eng.begin_feed_pass()
+    eng.add_keys(np.arange(1, 50, dtype=np.uint64))
+    eng.end_feed_pass()
+    eng.begin_pass()
+    eng.freeze_for_serving()
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="serving-frozen"):
+        eng.end_pass()
