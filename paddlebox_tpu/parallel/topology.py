@@ -69,9 +69,17 @@ class HybridTopology:
         return NamedSharding(self.mesh, self.batch_spec())
 
     def table_spec(self) -> P:
-        """Pass-working-set embedding rows sharded across *all* non-pipeline
+        """Pass-working-set embedding rows sharded across non-pipeline
         devices — the TPU analogue of HeterComm's ``key % device_count``
-        placement (heter_comm_inl.h:1117)."""
+        placement (heter_comm_inl.h:1117).
+
+        With BOTH dp > 1 and sharding > 1 the layout flips to the
+        reference's multi-node shape: sharded within a node (sharding =
+        intra-node/ICI), REPLICATED across nodes (dp = node/DCN axis) —
+        the layout gather_multi_node_grad assumes (heter_comm_inl.h:2131:
+        every node holds the full pass, gradients sum across nodes)."""
+        if self.axis_size("dp") > 1 and self.axis_size("sharding") > 1:
+            return P(("sharding", "mp", "sp", "ep"))
         return P(("dp", "sharding", "mp", "sp", "ep"))
 
     def table_sharding(self) -> NamedSharding:

@@ -139,3 +139,33 @@ def push_rows_sharded_mxu(idx_local: jnp.ndarray,
     delta = sp.scatter_add_sorted(srt, rows2d, ch, tl, fs, dims,
                                   interpret=interpret)
     return delta[:, :rows_loc]
+
+
+def push_rows_sharded_mxu_multinode(idx_local: jnp.ndarray,
+                                    payload_local: jnp.ndarray,
+                                    rows_loc: int, ici_axis, dcn_axis,
+                                    interpret: bool = False,
+                                    first_only_col: int = -1) -> jnp.ndarray:
+    """Two-tier push for the reference's multi-node layout: the table is
+    sharded WITHIN a node (ici axis) and REPLICATED across nodes (dcn
+    axis), nodes are data-parallel over the batch.
+
+    ≙ gather_one_node_grad + gather_multi_node_grad
+    (heter_comm_inl.h:2027,2131): stage 1 merges the node's own batch into
+    this device's row block over ICI (all_gather ids/payload + local
+    sorted-SpMM merge); stage 2 sums the node-merged [W, rows_loc] deltas
+    across nodes over DCN — the per-node merge keeps the cross-node bytes
+    at one dense block instead of every node's raw occurrence payload
+    (the reference's reason for merging before the inter-node allgather).
+
+    The first_only column (slot carry) is made node-consistent by pmax
+    instead of the sum (each node's merge elects a first occurrence; the
+    sum would add them)."""
+    delta_node = push_rows_sharded_mxu(idx_local, payload_local, rows_loc,
+                                       ici_axis, interpret=interpret,
+                                       first_only_col=first_only_col)
+    if first_only_col >= 0:
+        slots = lax.pmax(delta_node[first_only_col], dcn_axis)
+        delta = lax.psum(delta_node.at[first_only_col].set(0.0), dcn_axis)
+        return delta.at[first_only_col].set(slots)
+    return lax.psum(delta_node, dcn_axis)

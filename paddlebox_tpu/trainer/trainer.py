@@ -150,16 +150,22 @@ class SparseTrainer:
 
     def _mxu_shardable(self) -> bool:
         """mxu_sharded wants the HeterComm-symmetric layout: every device
-        holds a batch shard AND a table shard, i.e. a pure dp×sharding
-        mesh (pp/mp/sp/ep all 1) with evenly divisible batch and table."""
+        holds a batch shard AND a table shard, on a pure dp×sharding mesh
+        (pp/mp/sp/ep all 1) with evenly divisible batch and table.  With
+        BOTH axes > 1 the multi-node layout applies (table sharded over
+        `sharding`, replicated over `dp` — topology.table_spec), so the
+        table must divide by the sharding degree only."""
         if self.topology is None:
             return False
         t = self.topology
         if any(t.axis_size(a) != 1 for a in ("pp", "mp", "sp", "ep")):
             return False
         n_dev = t.axis_size("dp") * t.axis_size("sharding")
+        n_tbl = (t.axis_size("sharding")
+                 if t.axis_size("dp") > 1 and t.axis_size("sharding") > 1
+                 else n_dev)
         return (self.batch_size % n_dev == 0
-                and self.engine.ws["show"].shape[0] % n_dev == 0)
+                and self.engine.ws["show"].shape[0] % n_tbl == 0)
 
     def _validate_path(self, path: str) -> None:
         """Reject configs a path cannot honor — both the per-batch and the
@@ -318,16 +324,27 @@ class SparseTrainer:
             interpret = jax.default_backend() == "cpu"
             half = self._pooled_dense_half()
             mesh = self.topology.mesh
-            axes = ("dp", "sharding")
-            n_dev = (self.topology.axis_size("dp")
-                     * self.topology.axis_size("sharding"))
+            batch_axes = ("dp", "sharding")
+            # multi-node layout when both axes are real: table sharded over
+            # `sharding` (intra-node/ICI), replicated over `dp` (node/DCN),
+            # push merges per node then psums across nodes
+            # (≙ gather_one_node_grad + gather_multi_node_grad,
+            # heter_comm_inl.h:2027,2131); otherwise one flat pool
+            multinode = (self.topology.axis_size("dp") > 1
+                         and self.topology.axis_size("sharding") > 1)
+            tbl_axes = ("sharding",) if multinode else batch_axes
+            n_tbl = 1
+            for a in tbl_axes:
+                n_tbl *= self.topology.axis_size(a)
+            tbl_spec1 = P(tbl_axes)
+            tbl_spec2 = P(tbl_axes, None)
 
             def core(ws, params, opt_state, auc_state, idx_slb, lengths,
                      dense, labels, valid, plan):
                 s, l, b = idx_slb.shape
                 d = ws["mf"].shape[1]
                 n_rows = ws["show"].shape[0]
-                rows_loc = n_rows // n_dev
+                rows_loc = n_rows // n_tbl
                 idx_slb = jnp.where(jnp.arange(l)[None, :, None]
                                     < lengths[:, None, :], idx_slb, 0)
 
@@ -335,16 +352,19 @@ class SparseTrainer:
                     tab = jnp.concatenate(
                         [show[None], click[None], embed_w[None], mf.T,
                          mf_size.astype(jnp.float32)[None]], axis=0)
+                    # multinode: the node's replica serves its own batch
+                    # shard — ids/values travel over ICI only
                     vals = se.pull_rows_sharded_mxu(
-                        tab, idx_loc.reshape(-1), axes, interpret=interpret)
+                        tab, idx_loc.reshape(-1), tbl_axes,
+                        interpret=interpret)
                     b_loc = idx_loc.shape[2]
                     return vals.T.reshape(s, l, b_loc, 3 + d + 1)
 
                 v = jax.shard_map(
                     pull_local, mesh=mesh,
-                    in_specs=(P(axes), P(axes), P(axes), P(axes, None),
-                              P(axes), P(None, None, axes)),
-                    out_specs=P(None, None, axes, None),
+                    in_specs=(tbl_spec1, tbl_spec1, tbl_spec1, tbl_spec2,
+                              tbl_spec1, P(None, None, batch_axes)),
+                    out_specs=P(None, None, batch_axes, None),
                     check_vma=False)(
                     ws["show"], ws["click"], ws["embed_w"], ws["mf"],
                     ws["mf_size"], idx_slb)
@@ -360,14 +380,20 @@ class SparseTrainer:
                 def push_local(idx_loc, pay_loc):
                     p_loc = idx_loc.size
                     pay_fm = pay_loc.reshape(p_loc, d + 4).T  # [D+4, P_loc]
+                    if multinode:
+                        return se.push_rows_sharded_mxu_multinode(
+                            idx_loc.reshape(-1), pay_fm, rows_loc,
+                            tbl_axes, "dp", interpret=interpret,
+                            first_only_col=d + 3)
                     return se.push_rows_sharded_mxu(
-                        idx_loc.reshape(-1), pay_fm, rows_loc, axes,
+                        idx_loc.reshape(-1), pay_fm, rows_loc, tbl_axes,
                         interpret=interpret, first_only_col=d + 3)
 
                 delta = jax.shard_map(
                     push_local, mesh=mesh,
-                    in_specs=(P(None, None, axes), P(None, None, axes, None)),
-                    out_specs=P(None, axes),
+                    in_specs=(P(None, None, batch_axes),
+                              P(None, None, batch_axes, None)),
+                    out_specs=P(None, tbl_axes),
                     check_vma=False)(idx_slb, payload)        # [D+4, n_rows]
                 acc = mxu_path.acc_from_delta(delta, n_rows)
                 ws = sparse_opt.apply_push(ws, acc, sgd_cfg)

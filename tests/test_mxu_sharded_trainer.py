@@ -142,3 +142,36 @@ def test_mxu_sharded_rejects_non_dp_mesh():
     blocks = _make_blocks()
     with pytest.raises(ValueError, match="mxu_sharded"):
         _run(blocks, topo, "mxu_sharded")
+
+
+def test_multinode_layout_matches_single_device():
+    """dp>1 AND sharding>1 → the multi-node layout: table sharded within a
+    'node' (sharding axis), replicated across nodes (dp axis); push merges
+    per node then sums across nodes (≙ gather_one_node_grad +
+    gather_multi_node_grad, heter_comm_inl.h:2027,2131).  Must train
+    identically to the single-device mxu path."""
+    blocks = _make_blocks(seed=7)
+    s_ref, e_ref, _ = _run(blocks, None, "mxu")
+    topo = HybridTopology(MeshConfig(dp=2, sharding=4), jax.devices()[:8])
+    s_mn, e_mn, tr = _run(blocks, topo, "auto")
+    assert tr._resolve_path() == "mxu_sharded"
+    # the table must be replicated over dp, sharded over sharding
+    assert topo.table_spec() == __import__("jax").sharding.PartitionSpec(
+        ("sharding", "mp", "sp", "ep"))
+    assert np.isclose(s_ref["loss"], s_mn["loss"], atol=5e-4)
+    assert np.isclose(s_ref["auc"], s_mn["auc"], atol=5e-3)
+    _assert_ws_close(e_ref.ws, e_mn.ws)
+
+
+def test_flat_pool_layout_matches_single_device():
+    """sharding=1 keeps the flat HeterComm pool (table sharded over every
+    device, no node replication)."""
+    blocks = _make_blocks(seed=9)
+    s_ref, e_ref, _ = _run(blocks, None, "mxu")
+    topo = HybridTopology(MeshConfig(dp=8), jax.devices()[:8])
+    s_fl, e_fl, tr = _run(blocks, topo, "auto")
+    assert tr._resolve_path() == "mxu_sharded"
+    assert topo.table_spec() == jax.sharding.PartitionSpec(
+        ("dp", "sharding", "mp", "sp", "ep"))
+    assert np.isclose(s_ref["loss"], s_fl["loss"], atol=5e-4)
+    _assert_ws_close(e_ref.ws, e_fl.ws)
