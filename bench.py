@@ -274,8 +274,12 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
 
     model = DeepFM(num_slots=N_SLOTS, emb_width=3 + MF_DIM,
                    dense_dim=DENSE_DIM, hidden=(400, 400, 400))
+    # amp: bf16 dense compute with f32 master weights (the fleet amp
+    # meta-optimizer ≙) — MXU-native precision for the MLP
+    amp = os.environ.get("BENCH_AMP", "1") == "1"
     trainer = SparseTrainer(engine, model, dataset.feed_config,
-                            batch_size=batch_size, auc_table_size=100_000)
+                            batch_size=batch_size, auc_table_size=100_000,
+                            amp=amp)
     assert trainer._resolve_path() == "mxu", trainer._resolve_path()
 
     # pass-resident feed: pack + translate + upload + plans at pass-build
@@ -378,7 +382,8 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
             "batches": int(stats["batches"]), "examples": int(n_examples),
             "auc": round(float(stats.get("auc", float("nan"))), 4),
             "compile_s": round(compile_s, 1), "pass_pack_s": round(pack_s, 1),
-            "step_ms": step_ms, "timers": trainer.timers.report()}
+            "amp": amp, "step_ms": step_ms,
+            "timers": trainer.timers.report()}
 
 
 def run() -> None:
@@ -420,7 +425,7 @@ def run() -> None:
          batches=full["batches"], examples=full["examples"],
          auc=full["auc"], backend=backend, pack_threads=PACK_THREADS,
          compile_s=full["compile_s"], pass_pack_s=full["pass_pack_s"],
-         step_ms=full["step_ms"], timers=full["timers"])
+         amp=full["amp"], step_ms=full["step_ms"], timers=full["timers"])
 
 
 def main() -> None:

@@ -92,6 +92,8 @@ class AccessorConfig:
     show/click time-decay each pass-day, delete/shrink thresholds, save
     thresholds for base/delta dumps."""
 
+    accessor_type: str = "ctr"       # "ctr" | "ctr_double" (f64 show/click,
+                                     # ≙ DownpourCtrDoubleAccessor)
     show_click_decay_rate: float = 0.98
     delete_threshold: float = 0.8
     delete_after_unseen_days: float = 30.0

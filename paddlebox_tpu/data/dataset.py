@@ -138,6 +138,7 @@ class SlotDataset:
 
     def load_into_memory(self) -> None:
         self._blocks = self._read_all()
+        self._pv_grouped = False   # fresh records: re-run preprocess_instance
         stat_add("stat_dataset_instances", self.instance_num())
 
     def preload_into_memory(self) -> None:
@@ -151,12 +152,14 @@ class SlotDataset:
         if self._preload_future is not None:
             self._blocks = self._preload_future.result()
             self._preload_future = None
+            self._pv_grouped = False
 
     def release_memory(self) -> None:
         self._blocks = []
 
     # -- shuffle -------------------------------------------------------------
     def local_shuffle(self) -> None:
+        self._pv_grouped = False   # order destroyed; regroup afterwards
         block = SlotRecordBlock.concat(self._blocks)
         if block.n:
             block = block.permute(self._rng.permutation(block.n))
@@ -165,6 +168,7 @@ class SlotDataset:
     def global_shuffle(self, by_ins_id: bool = False) -> None:
         """Redistribute records across hosts: hash(ins_id) or random % world
         (≙ ShuffleData data_set.cc:2440 + ReceiveSuffleData :2548)."""
+        self._pv_grouped = False   # order destroyed; regroup afterwards
         world = self.transport.world_size
         if world <= 1:
             return self.local_shuffle()
@@ -195,12 +199,21 @@ class SlotDataset:
     def preprocess_instance(self) -> None:
         """Group records by search_id so a page-view trains as a unit
         (≙ PreprocessInstance data_set.cc:2648).  Records are stably sorted
-        by search_id; un-keyed records keep relative order at the end."""
+        by search_id; un-keyed records keep relative order at the end.
+        Afterwards ``batches()`` cuts only at page-view boundaries, so a PV
+        never straddles two device batches (≙ SlotPvInstance batching —
+        the batch holds whole pvs)."""
         merged = SlotRecordBlock.concat(self._blocks)
         if merged.n == 0 or merged.search_ids is None:
             return
         order = np.argsort(merged.search_ids, kind="stable")
         self._blocks = [merged.permute(order)]
+        self._pv_grouped = True
+
+    def postprocess_instance(self) -> None:
+        """≙ PostprocessInstance (data_set.cc): leave PV mode — batches cut
+        at fixed size again."""
+        self._pv_grouped = False
 
     # -- iteration -----------------------------------------------------------
     def instance_num(self) -> int:
@@ -215,8 +228,37 @@ class SlotDataset:
     def batches(self, batch_size: int, drop_last: bool = False
                 ) -> Iterator[SlotRecordBlock]:
         """Yield fixed-size record batches; the tail short batch is yielded
-        unless drop_last (the device step pads it to capacity anyway)."""
+        unless drop_last (the device step pads it to capacity anyway).
+
+        After preprocess_instance(), cuts land on page-view boundaries
+        (short batches are padded by the trainer's valid mask) so a PV
+        trains as one unit."""
         merged = SlotRecordBlock.concat(self._blocks)
+        if getattr(self, "_pv_grouped", False) \
+                and merged.search_ids is not None and merged.n:
+            sid = merged.search_ids
+            # pv start positions (records are pv-sorted)
+            pv_starts = np.concatenate(
+                [[0], np.nonzero(sid[1:] != sid[:-1])[0] + 1, [merged.n]])
+            start_i = 0
+            while pv_starts[start_i] < merged.n:
+                start = int(pv_starts[start_i])
+                # furthest pv boundary within batch_size of start
+                stop_i = int(np.searchsorted(pv_starts,
+                                             start + batch_size, "right")) - 1
+                if stop_i == start_i:   # one pv larger than the batch
+                    raise ValueError(
+                        f"page view of "
+                        f"{int(pv_starts[start_i + 1]) - start} records "
+                        f"exceeds batch_size {batch_size} — raise the "
+                        "batch size or skip preprocess_instance")
+                stop = int(pv_starts[stop_i])
+                if stop - start < batch_size and drop_last \
+                        and stop == merged.n:
+                    return
+                yield merged.slice(start, stop)
+                start_i = stop_i
+            return
         for start in range(0, merged.n, batch_size):
             stop = min(start + batch_size, merged.n)
             if stop - start < batch_size and drop_last:

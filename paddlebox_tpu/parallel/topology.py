@@ -78,9 +78,19 @@ class HybridTopology:
         intra-node/ICI), REPLICATED across nodes (dp = node/DCN axis) —
         the layout gather_multi_node_grad assumes (heter_comm_inl.h:2131:
         every node holds the full pass, gradients sum across nodes)."""
-        if self.axis_size("dp") > 1 and self.axis_size("sharding") > 1:
+        if self.multinode_table():
             return P(("sharding", "mp", "sp", "ep"))
         return P(("dp", "sharding", "mp", "sp", "ep"))
+
+    def multinode_table(self) -> bool:
+        """Single source for the multi-node layout predicate (table_spec
+        and the trainer's mxu_sharded core must agree, or the table gets
+        dp-replicated for a path that never exploits it): pure dp×sharding
+        mesh with both axes real.  Size divisibility is validated by the
+        trainer on top of this."""
+        return (self.axis_size("dp") > 1 and self.axis_size("sharding") > 1
+                and all(self.axis_size(a) == 1
+                        for a in ("pp", "mp", "sp", "ep")))
 
     def table_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, self.table_spec())

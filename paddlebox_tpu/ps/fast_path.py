@@ -99,8 +99,8 @@ def push_and_update(ws: Dict[str, jnp.ndarray], idx: jnp.ndarray,
         jnp.where(occ > 0, slot_occ, 0))
 
     # -- scalar state: full-table [N] ops (8MB/pass — cheap) --------------
-    row = jnp.arange(n)
-    touched = (g_show > 0) & (row != 0)
+    from paddlebox_tpu.ps.optimizer import push_touched
+    touched = push_touched(ws, {"g_show": g_show})
     show = jnp.where(touched, ws["show"] + g_show, ws["show"])
     click = jnp.where(touched, ws["click"] + g_click, ws["click"])
     delta = jnp.where(
@@ -165,6 +165,11 @@ def push_and_update(ws: Dict[str, jnp.ndarray], idx: jnp.ndarray,
     out = {"show": show, "click": click, "delta_score": delta, "slot": slot,
            "embed_w": embed_w, "embed_g2sum": embed_g2sum,
            "mf_size": mf_size, "mf_g2sum": mf_g2sum, "mf": mf}
+    if "show_acc" in ws:   # ctr_double: exact pass-delta counters
+        out["show_acc"] = jnp.where(touched, ws["show_acc"] + g_show,
+                                    ws["show_acc"])
+        out["click_acc"] = jnp.where(touched, ws["click_acc"] + g_click,
+                                     ws["click_acc"])
     for extra in ("mf_ex", "mf_ex_g2sum"):
         if extra in ws:
             out[extra] = ws[extra]

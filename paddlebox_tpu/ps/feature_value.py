@@ -84,13 +84,20 @@ def state_fields(optimizer: str):
 
 
 def empty_soa(n: int, mf_dim: int, expand_dim: int = 0, adam: bool = False,
-              optimizer: str = "") -> Dict[str, np.ndarray]:
+              optimizer: str = "",
+              double_stats: bool = False) -> Dict[str, np.ndarray]:
+    """double_stats: f64 show/click on the host tier — the
+    CtrDoubleAccessor layout (ctr_double_accessor.h: DownpourCtrDouble
+    keeps show/click as double so billion-impression counters never
+    saturate f32's 2^24 integer range)."""
     out = {}
     extra = state_fields(optimizer) if optimizer else \
         (ADAM_FIELDS if adam else ())
     fields = HOST_FIELDS + (EXPAND_FIELDS if expand_dim > 0 else ()) \
         + extra
     for name, dtype, suffix in fields:
+        if double_stats and name in ("show", "click"):
+            dtype = np.float64
         shape = (n,) + tuple(
             mf_dim if s == "D" else (expand_dim if s == "E" else s)
             for s in suffix)
@@ -102,7 +109,8 @@ def default_rows(n: int, mf_dim: int, rng: np.random.Generator,
                  mf_initial_range: float, initial_range: float = 0.0,
                  expand_dim: int = 0, adam: bool = False,
                  beta1: float = 0.9, beta2: float = 0.999,
-                 optimizer: str = "") -> Dict[str, np.ndarray]:
+                 optimizer: str = "",
+                 double_stats: bool = False) -> Dict[str, np.ndarray]:
     """Fresh feature rows for keys unseen by the host table.
 
     embed_w ~ U(-initial_range, initial_range) (CPU rule init; default range 0
@@ -110,7 +118,7 @@ def default_rows(n: int, mf_dim: int, rng: np.random.Generator,
     ~ U(0, mf_initial_range) (≙ curand_uniform * mf_initial_range,
     optimizer.cuh.h:119-121) which stays masked until mf_size > 0.
     """
-    soa = empty_soa(n, mf_dim, expand_dim, adam, optimizer)
+    soa = empty_soa(n, mf_dim, expand_dim, adam, optimizer, double_stats)
     if initial_range > 0:
         soa["embed_w"] = rng.uniform(
             -initial_range, initial_range, size=(n,)).astype(np.float32)

@@ -28,10 +28,11 @@ from paddlebox_tpu.ps import feature_value as fv
 
 class _Shard:
     def __init__(self, mf_dim: int, expand_dim: int = 0, adam: bool = False,
-                 optimizer: str = ""):
+                 optimizer: str = "", double_stats: bool = False):
         self.optimizer = optimizer
         self.keys = np.empty((0,), np.uint64)
-        self.soa = fv.empty_soa(0, mf_dim, expand_dim, adam, optimizer)
+        self.soa = fv.empty_soa(0, mf_dim, expand_dim, adam, optimizer,
+                                double_stats)
         self.mf_dim = mf_dim
         # RLock: lookup lazily builds index state (native hash / sorted
         # view) and is called both bare (readers) and from under upsert
@@ -120,8 +121,11 @@ class ShardedHostTable:
         self.adam = config.sgd.optimizer in ("adam", "shared_adam")
         self.optimizer = config.sgd.optimizer
         self.shard_num = config.shard_num
+        # f64 show/click statistics (CtrDoubleAccessor ≙): counters keep
+        # exact integer semantics past f32's 2^24 range
+        self.double_stats = config.accessor.accessor_type == "ctr_double"
         self._shards = [_Shard(self.mf_dim, self.expand_dim, self.adam,
-                               self.optimizer)
+                               self.optimizer, self.double_stats)
                         for _ in range(self.shard_num)]
         self._rng = np.random.default_rng(seed)
 
@@ -144,7 +148,7 @@ class ShardedHostTable:
                               self.expand_dim, self.adam,
                               self.config.sgd.beta1_decay_rate,
                               self.config.sgd.beta2_decay_rate,
-                              self.optimizer)
+                              self.optimizer, self.double_stats)
         sid = self._shard_ids(keys)
         for s, shard in enumerate(self._shards):
             sel = np.nonzero(sid == s)[0]
@@ -264,10 +268,18 @@ class ShardedHostTable:
                         return np.full((n,) + tmpl.shape[1:], fill,
                                        tmpl.dtype)
 
-                    shard.soa = {
-                        name: (z[name] if name in z.files else
-                               init_missing(name, tmpl))
-                        for name, tmpl in shard.soa.items()}
+                    def from_ckpt(name, tmpl):
+                        if name not in z.files:
+                            return init_missing(name, tmpl)
+                        arr = z[name]
+                        # accessor migration (e.g. ctr -> ctr_double):
+                        # the template dtype wins or appended rows would
+                        # mix dtypes and f64 exactness silently degrades
+                        return arr.astype(tmpl.dtype) \
+                            if arr.dtype != tmpl.dtype else arr
+
+                    shard.soa = {name: from_ckpt(name, tmpl)
+                                 for name, tmpl in shard.soa.items()}
                     shard.rebuild_index()
             fh.close()
             loaded += shard.size

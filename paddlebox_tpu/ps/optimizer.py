@@ -52,12 +52,18 @@ def _adagrad_update(w, g2sum, g, scale, lr, initial_g2sum, min_bound,
             jnp.where(touched, g2sum + add_g2sum, g2sum))
 
 
+def push_touched(ws, acc):
+    """THE touched mask: rows this push updates (g_show > 0, reserved row
+    0 excluded).  Single source for every rule, the fast path, and the
+    ctr_double delta counters — they must agree bit-exactly."""
+    row = jnp.arange(ws["show"].shape[0])
+    return (acc["g_show"] > 0) & (row != 0)
+
+
 def _common_stats(ws, acc, cfg):
     """Shared show/click/delta accumulation + touched mask (the common
     prologue of every rule, ≙ optimizer.cuh.h:84-101)."""
-    n = ws["show"].shape[0]
-    row = jnp.arange(n)
-    touched = (acc["g_show"] > 0) & (row != 0)
+    touched = push_touched(ws, acc)
     show = jnp.where(touched, ws["show"] + acc["g_show"], ws["show"])
     click = jnp.where(touched, ws["click"] + acc["g_click"], ws["click"])
     delta = jnp.where(
@@ -397,4 +403,15 @@ OPTIMIZERS = {
 def apply_push(ws, acc, cfg: SparseSGDConfig, dims_row=None):
     """dims_row: optional per-row [N] mf dims (dynamic-dim accessor,
     ≙ CtrDymfAccessor) — rules divide/mask by the row's true width."""
-    return OPTIMIZERS[cfg.optimizer](ws, acc, cfg, dims_row)
+    out = OPTIMIZERS[cfg.optimizer](ws, acc, cfg, dims_row)
+    # ctr_double accessor support: exact pass-delta counters ride along —
+    # small magnitudes, so the f32 adds are exact even when the absolute
+    # show has outgrown f32's integer range; end_pass merges them into the
+    # host's f64 stats (≙ DownpourCtrDoubleAccessor's double update)
+    if "show_acc" in ws:
+        touched = push_touched(ws, acc)
+        out["show_acc"] = jnp.where(touched, ws["show_acc"] + acc["g_show"],
+                                    ws["show_acc"])
+        out["click_acc"] = jnp.where(
+            touched, ws["click_acc"] + acc["g_click"], ws["click_acc"])
+    return out

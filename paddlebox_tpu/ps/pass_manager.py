@@ -99,11 +99,27 @@ class BoxPSEngine:
         return embedding.PassKeyMapper(uniq), len(uniq), host_rows
 
     def _upload(self, host_rows) -> Dict[str, jnp.ndarray]:
+        # ctr_double accessor: the host keeps f64 show/click; the device
+        # trains in f32, so end_pass writes back host + (device delta) in
+        # f64 — counters stay exact past f32's 2^24 integer range
+        # (≙ DownpourCtrDoubleAccessor, ctr_double_accessor.h)
+        if host_rows["show"].dtype == np.float64:
+            self._pulled_stats = {f: host_rows[f].copy()
+                                  for f in ("show", "click")}
+        else:
+            self._pulled_stats = None
         with self.timers("build_device"):
             sharding = (self.topology.table_sharding()
                         if self.topology is not None else None)
-            return embedding.build_working_set(
+            ws = embedding.build_working_set(
                 host_rows, self.config.embedding_dim, sharding=sharding)
+            if self._pulled_stats is not None:
+                # exact per-pass counter accumulators (small magnitudes
+                # stay exact in f32); merged into the f64 host stats at
+                # end_pass
+                ws["show_acc"] = jnp.zeros_like(ws["show"])
+                ws["click_acc"] = jnp.zeros_like(ws["click"])
+            return ws
 
     def _build(self, uniq: np.ndarray) -> tuple:
         mapper, n, host_rows = self._build_host(uniq)
@@ -184,6 +200,11 @@ class BoxPSEngine:
             return
         with self.timers("refresh_stale"):
             fresh = self.table.bulk_pull(stale)
+            if getattr(self, "_pulled_stats", None) is not None:
+                pos = np.searchsorted(self.mapper.sorted_keys, stale)
+                for f in ("show", "click"):
+                    if f in fresh:
+                        self._pulled_stats[f][pos] = fresh[f]
             if hasattr(self.table, "patch_snapshot"):
                 # delta-mode remote tables: the refreshed values must also
                 # replace the write-back base for these rows (service.py
@@ -209,6 +230,15 @@ class BoxPSEngine:
         with self.timers("dump_to_cpu"):
             soa = embedding.dump_working_set(self.ws, self.num_keys)
             soa["unseen_days"] = np.zeros((self.num_keys,), np.float32)
+            if getattr(self, "_pulled_stats", None) is not None:
+                # f64 base + the exact per-pass delta accumulators — the
+                # absolute device copy may have rounded (f32 at 2^24+),
+                # the small-magnitude delta did not
+                for f in ("show", "click"):
+                    soa[f] = self._pulled_stats[f] + \
+                        soa[f + "_acc"].astype(np.float64)
+                    del soa[f + "_acc"]
+                self._pulled_stats = None
             self.table.bulk_write(self.mapper.sorted_keys, soa)
         self.ws = None
         self._last_written = np.asarray(self.mapper.sorted_keys)

@@ -161,9 +161,7 @@ class SparseTrainer:
         if any(t.axis_size(a) != 1 for a in ("pp", "mp", "sp", "ep")):
             return False
         n_dev = t.axis_size("dp") * t.axis_size("sharding")
-        n_tbl = (t.axis_size("sharding")
-                 if t.axis_size("dp") > 1 and t.axis_size("sharding") > 1
-                 else n_dev)
+        n_tbl = (t.axis_size("sharding") if t.multinode_table() else n_dev)
         return (self.batch_size % n_dev == 0
                 and self.engine.ws["show"].shape[0] % n_tbl == 0)
 
@@ -330,8 +328,7 @@ class SparseTrainer:
             # push merges per node then psums across nodes
             # (≙ gather_one_node_grad + gather_multi_node_grad,
             # heter_comm_inl.h:2027,2131); otherwise one flat pool
-            multinode = (self.topology.axis_size("dp") > 1
-                         and self.topology.axis_size("sharding") > 1)
+            multinode = self.topology.multinode_table()
             tbl_axes = ("sharding",) if multinode else batch_axes
             n_tbl = 1
             for a in tbl_axes:

@@ -79,3 +79,97 @@ def test_host_table_load_zero_inits_missing_fields(tmp_path):
         else:
             exp = 0.0
         assert np.all(arr == exp), (f, arr[:3], exp)
+
+
+def test_ctr_double_accessor_exact_counters():
+    """DownpourCtrDoubleAccessor equivalent: f64 host show/click +
+    delta-based write-back keep counters exact past f32's 2^24 integer
+    range, where the f32 accessor visibly rounds."""
+    import jax.numpy as jnp
+    from paddlebox_tpu.config import AccessorConfig
+
+    big = float(1 << 25)          # f32 spacing here is 4.0
+
+    def run(accessor_type):
+        eng = BoxPSEngine(EmbeddingTableConfig(
+            embedding_dim=4, shard_num=2,
+            accessor=AccessorConfig(accessor_type=accessor_type),
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+        keys = np.arange(1, 10, dtype=np.uint64)
+        rows = eng.table.bulk_pull(keys)
+        rows["show"] = rows["show"] * 0 + big
+        rows["unseen_days"] = np.zeros((len(keys),), np.float32)
+        eng.table.bulk_write(keys, rows)
+
+        eng.begin_feed_pass()
+        eng.add_keys(keys)
+        eng.end_feed_pass()
+        eng.begin_pass()
+        # a pass's worth of impressions: +3 per key, exactly what the
+        # optimizer's push does (absolute add + the exact delta counter)
+        bump = jnp.where(jnp.arange(eng.ws["show"].shape[0]) == 0, 0.0, 3.0)
+        eng.ws["show"] = eng.ws["show"] + bump
+        if "show_acc" in eng.ws:
+            assert accessor_type == "ctr_double"
+            eng.ws["show_acc"] = eng.ws["show_acc"] + bump
+        eng.end_pass()
+        return float(eng.table.bulk_pull(keys)["show"][0])
+
+    assert run("ctr_double") == big + 3.0         # exact
+    assert run("ctr") != big + 3.0                # f32 rounds at this scale
+
+
+def test_ctr_double_trains_through_the_trainer():
+    """The delta counters ride through the real step (all paths go via
+    apply_push or the fast path's inline rule): end_pass lands exact f64
+    show on top of a beyond-f32 base."""
+    import jax.numpy as jnp
+    from paddlebox_tpu.config import (AccessorConfig, DataFeedConfig,
+                                      SlotConfig)
+    from paddlebox_tpu.data.dataset import SlotDataset
+    from paddlebox_tpu.data.slot_record import SlotRecordBlock
+    from paddlebox_tpu.models.deepfm import DeepFM
+    from paddlebox_tpu.trainer.trainer import SparseTrainer
+
+    cfg = DataFeedConfig(slots=(
+        SlotConfig("label", dtype="float", is_dense=True, dim=1),
+        SlotConfig("dense0", dtype="float", is_dense=True, dim=2),
+        SlotConfig("s0", slot_id=100, capacity=1),
+    ))
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=4, shard_num=2,
+        accessor=AccessorConfig(accessor_type="ctr_double"),
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    big = float(1 << 25)
+    keys = np.arange(1, 5, dtype=np.uint64)
+    rows = eng.table.bulk_pull(keys)
+    rows["show"] = rows["show"] * 0 + big
+    rows["unseen_days"] = np.zeros((len(keys),), np.float32)
+    eng.table.bulk_write(keys, rows)
+
+    n = 32
+    rng = np.random.default_rng(0)
+    blk = SlotRecordBlock(n=n)
+    blk.uint64_slots["s0"] = (
+        np.full((n,), 1, np.uint64),   # every record shows key 1
+        np.arange(n + 1, dtype=np.int64))
+    blk.float_slots["label"] = (
+        rng.integers(0, 2, size=n).astype(np.float32),
+        np.arange(n + 1, dtype=np.int64))
+    blk.float_slots["dense0"] = (
+        rng.normal(0, 1, size=n * 2).astype(np.float32),
+        np.arange(n + 1, dtype=np.int64) * 2)
+    ds = SlotDataset(cfg)
+    ds._blocks = [blk]
+
+    eng.begin_feed_pass()
+    eng.add_keys(blk.all_keys())
+    eng.end_feed_pass()
+    eng.begin_pass()
+    model = DeepFM(num_slots=1, emb_width=7, dense_dim=2, hidden=(8,))
+    tr = SparseTrainer(eng, model, cfg, batch_size=n, seed=0)
+    tr.train_pass(ds)
+    eng.end_pass()
+    out = eng.table.bulk_pull(keys)
+    assert out["show"].dtype == np.float64
+    assert out["show"][0] == big + n    # every record showed key 1 — exact

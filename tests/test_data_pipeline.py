@@ -169,3 +169,49 @@ def test_preload(slot_file):
     assert ds.instance_num() == 5
     ds.release_memory()
     assert ds.instance_num() == 0
+
+
+def test_pv_aligned_batches():
+    """After preprocess_instance, batches cut at page-view boundaries — a
+    search_id never straddles two batches (≙ SlotPvInstance batching,
+    data_set.cc:2648)."""
+    from paddlebox_tpu.data.slot_record import SlotRecordBlock
+
+    rng = np.random.default_rng(0)
+    n = 50
+    blk = SlotRecordBlock(n=n)
+    blk.uint64_slots["s0"] = (
+        rng.integers(1, 100, size=n).astype(np.uint64),
+        np.arange(n + 1, dtype=np.int64))
+    blk.float_slots["label"] = (
+        rng.integers(0, 2, size=n).astype(np.float32),
+        np.arange(n + 1, dtype=np.int64))
+    # 12 page views of sizes 1..8, shuffled record order
+    sizes = rng.integers(1, 9, size=12)
+    sid = np.repeat(np.arange(1, 13, dtype=np.uint64), sizes)[:n]
+    sid = np.pad(sid, (0, max(0, n - len(sid))), constant_values=12)
+    perm = rng.permutation(n)
+    blk.search_ids = sid[perm][:n]
+
+    cfg = DataFeedConfig(slots=(
+        SlotConfig("label", dtype="float", is_dense=True, dim=1),
+        SlotConfig("s0", slot_id=100, capacity=1)))
+    ds = SlotDataset(cfg)
+    ds._blocks = [blk]
+    ds.preprocess_instance()
+
+    B = 16
+    seen = []
+    for batch in ds.batches(B):
+        assert 0 < batch.n <= B
+        ids = batch.search_ids
+        seen.append(ids)
+    flat = np.concatenate(seen)
+    assert len(flat) == n                       # every record exactly once
+    # no search_id spans two batches
+    for a, b in zip(seen[:-1], seen[1:]):
+        assert a[-1] != b[0]
+    # leaving pv mode restores fixed-size batching
+    ds.postprocess_instance()
+    sizes2 = [bt.n for bt in ds.batches(B)]
+    assert sizes2[:-1] == [B] * (len(sizes2) - 1)
