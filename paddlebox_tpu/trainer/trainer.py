@@ -620,7 +620,8 @@ class SparseTrainer:
             self.params = jax.device_put(self.async_dense.pull())
         out = self._finalize_metrics(self.auc_state)
         out["batches"] = n_batches
-        out["loss"] = float(np.mean([float(x) for x in losses])) \
+        # one stacked device->host sync, not one RPC per batch scalar
+        out["loss"] = float(jnp.mean(jnp.stack(losses))) \
             if losses else float("nan")
         return out
 
@@ -771,7 +772,8 @@ class SparseTrainer:
 
         out = self._finalize_metrics(auc_state)
         out["batches"] = n_batches
-        out["loss"] = float(np.mean([float(l) for l in losses])) \
+        # one stacked device->host sync, not one RPC per batch scalar
+        out["loss"] = float(jnp.mean(jnp.stack(losses))) \
             if losses else float("nan")
         return out
 
