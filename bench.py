@@ -209,9 +209,10 @@ def _profile_step_phases(trainer, feed, k=8):
     def vary(c):  # cheap data-dependence injection, defeats loop CSE
         return {**ws, "show": ws["show"] + c}
 
+    cross = getattr(trainer, "_mxu_crossing", ("take", "take"))
     t_pull = timed(lambda c: c + mxu_path.pull_pool_cvm(
         vary(c), plan, dims, (s, l, b), trainer.use_cvm,
-        interpret=interpret).sum())
+        interpret=interpret, crossing=cross[0]).sum())
 
     def dense_body(c):
         out = half(trainer.params, trainer.opt_state, trainer.auc_state,
@@ -222,14 +223,16 @@ def _profile_step_phases(trainer, feed, k=8):
     def push_body(c):
         w2 = mxu_path.push_and_update(vary(c), plan, dims, bt["indices"],
                                       pooled0 + c, ins_cvm, slot_ids,
-                                      sgd_cfg, interpret=interpret)
+                                      sgd_cfg, interpret=interpret,
+                                      crossing=cross[1])
         return c + w2["show"][0]
     t_push = timed(push_body)
 
-    out = {name: max(0.0, (t - floor) / k * 1e3)
+    out = {name: round(max(0.0, (t - floor) / k * 1e3), 2)
            for name, t in (("pull_pool", t_pull), ("dense_fwd_bwd", t_dense),
                            ("push_optimizer", t_push))}
-    return {key: round(v, 2) for key, v in out.items()}
+    out["crossing"] = f"{cross[0]}/{cross[1]}"
+    return out
 
 
 def run_config(tag, batch_size, n_batches, n_keys, pack_threads):

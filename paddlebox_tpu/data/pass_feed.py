@@ -160,13 +160,13 @@ def _relayout(d, N: int, B: int):
     return out
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _build_plans(idx_all, dims):
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _build_plans(idx_all, dims, eff):
     from paddlebox_tpu.ops import sorted_spmm as sp
 
     def one(idx_slb):
         (rows2d, perm, inv_perm, ch, tl, fg, fs,
-         first_occ) = sp.build_plan(idx_slb.reshape(-1), dims)
+         first_occ) = sp.build_plan(idx_slb.reshape(-1), dims, eff)
         return {"rows2d": rows2d, "perm": perm, "inv_perm": inv_perm,
                 "ch": ch, "tl": tl, "fg": fg, "fs": fs,
                 "first_occ": first_occ}
@@ -219,12 +219,17 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
                           host=h if keep_host else None)
 
 
-def precompute_plans(feed: PackedPassFeed, dims) -> None:
+def precompute_plans(feed: PackedPassFeed, dims, eff=None) -> None:
     """Per-batch sorted-spmm plans, built on device in one jit and kept
     resident (≙ the pass-scope dedup/index build of box_wrapper_impl.h:129:
     the sort is data-independent of the training state, so it runs once at
-    pass build, never in the hot step)."""
-    feed.plans = _build_plans(feed.data["indices"], dims)
+    pass build, never in the hot step).
+
+    eff (sorted_spmm.trimmed_dims, shared by ALL batches so the stacked
+    plan arrays are homogeneous): trim leading padding occurrences from the
+    kernel worklist — the caller derives it from the max real-occurrence
+    count over the pass's batches."""
+    feed.plans = _build_plans(feed.data["indices"], dims, eff)
     feed.plan_dims = dims
 
 

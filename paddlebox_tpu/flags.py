@@ -85,3 +85,7 @@ define_flag("pass_build_chunk", 500_000,
             "host->device pass-build chunk size (ps_gpu_wrapper.cc:757)")
 define_flag("tpu_batch_key_capacity", 0,
             "static per-batch key capacity; 0 = derive from data feed config")
+define_flag("mxu_crossing", "auto",
+            "sorted<->canonical crossing lowering for the mxu sparse path: "
+            "take | sort | auto (auto = time both once per geometry on the "
+            "live backend; ops/crossing.py)")

@@ -79,7 +79,39 @@ def spmm_dims(p: int, n_rows: int, chunk: int = CHUNK,
                     chunk=chunk, tile=tile)
 
 
-def build_plan(rows: jnp.ndarray, dims: SpmmDims):
+def with_p_pad(dims: SpmmDims, p_pad: int) -> SpmmDims:
+    """The same table geometry over a different (chunk-aligned) sorted-
+    domain width — single source of the n_work = n_chunks + n_tiles
+    worklist invariant for trimmed plans."""
+    n_chunks = p_pad // dims.chunk
+    return dataclasses.replace(dims, p=p_pad, p_pad=p_pad, n_chunks=n_chunks,
+                               n_work=n_chunks + dims.n_tiles)
+
+
+def trimmed_dims(dims: SpmmDims, max_real: int) -> SpmmDims:
+    """Static geometry for a plan that drops leading padding occurrences.
+
+    Padding/unseen occurrences carry row 0 and therefore sort to the FRONT
+    of the sorted domain; keeping only the last `keep` sorted positions
+    (chunk-aligned, `keep >= max_real + sentinel tail`) still covers every
+    real occurrence.  At avg_len < capacity this shrinks the kernel
+    worklist and the push crossing by the padding fraction (the reference
+    never materializes padding at all — its pack is LoD-ragged,
+    data_feed.cu:1210; this is the static-shape equivalent).
+
+    The kept width is bucketed to 1/8ths of the full width so passes whose
+    widest batch drifts between builds land on at most 8 distinct plan
+    shapes — a new shape retraces the packed step jit, and an unbounded
+    per-pass recompile would cost far more than the trim saves.
+    """
+    tail = dims.p_pad - dims.p          # sentinel-padded tail, always kept
+    keep = _round_up(min(dims.p_pad, max(max_real + tail, 1)), dims.chunk)
+    granule = _round_up(max(dims.p_pad // 8, dims.chunk), dims.chunk)
+    keep = min(_round_up(keep, granule), dims.p_pad)
+    return with_p_pad(dims, keep)
+
+
+def build_plan(rows: jnp.ndarray, dims: SpmmDims, eff: SpmmDims = None):
     """Sort the occurrence row ids and enumerate (chunk, tile) work items.
 
     rows: [p] int32 in canonical (slot, lod, batch) order.
@@ -89,6 +121,17 @@ def build_plan(rows: jnp.ndarray, dims: SpmmDims):
     occurrence of each distinct row in sorted order — lets a scatter carry an
     exact "any one occurrence" column (e.g. the slot id) instead of a mean.
     Everything vectorized — no serial scatters.
+
+    eff (from `trimmed_dims`): emit the trimmed plan instead — the sorted
+    arrays keep only the last eff.p_pad positions (callers guarantee the
+    dropped prefix is all row-0 occurrences, i.e. the number of nonzero
+    rows is <= eff.p_pad - (dims.p_pad - dims.p)).  Shape changes:
+    rows2d [eff.n_chunks, chunk] and the worklist shrink; perm stays the
+    FULL [p] bijection (sorted position -> canonical source, position 0 =
+    first DROPPED element — consumers derive the kept suffix with a static
+    slice, see mxu_path); inv_perm [p] becomes the kept-domain position,
+    NEGATIVE for dropped (row-0) occurrences — gather consumers mask those
+    to zero, exactly the value row 0 holds.
     """
     p, c, t = dims.p, dims.chunk, dims.tile
     iota = jnp.arange(p, dtype=jnp.int32)
@@ -97,6 +140,11 @@ def build_plan(rows: jnp.ndarray, dims: SpmmDims):
     inv_perm = jax.lax.sort((perm, iota), num_keys=1)[1]
     pad = jnp.full((dims.p_pad - p,), dims.sentinel, jnp.int32)
     rows_padded = jnp.concatenate([sorted_rows, pad])
+    if eff is not None and eff.p_pad < dims.p_pad:
+        p0 = dims.p_pad - eff.p_pad     # static, chunk-aligned
+        rows_padded = rows_padded[p0:]
+        inv_perm = inv_perm - p0
+        dims = eff
     first_occ = jnp.concatenate(
         [jnp.ones((1,), jnp.float32),
          (rows_padded[1:] != rows_padded[:-1]).astype(jnp.float32)])

@@ -25,7 +25,7 @@ writes, not an [N, D] relayout.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,9 +39,20 @@ def make_dims(num_occurrences: int, num_rows: int) -> sp.SpmmDims:
     return sp.spmm_dims(num_occurrences, num_rows)
 
 
-def build_plan(idx_slb: jnp.ndarray, dims: sp.SpmmDims):
+def build_plan(idx_slb: jnp.ndarray, dims: sp.SpmmDims,
+               eff: sp.SpmmDims = None):
     """idx_slb [S, L, B] pass rows (0 = reserved/padding row)."""
-    return sp.build_plan(idx_slb.reshape(-1), dims)
+    return sp.build_plan(idx_slb.reshape(-1), dims, eff)
+
+
+def plan_eff_dims(plan, dims: sp.SpmmDims) -> Optional[sp.SpmmDims]:
+    """Trimmed kernel geometry a plan was built with, recovered from its
+    static array shapes (None = untrimmed) — so consumers need no side
+    channel and jit retraces correctly when the trim width changes."""
+    n_chunks = plan[0].shape[0]
+    if n_chunks == dims.n_chunks:
+        return None
+    return sp.with_p_pad(dims, n_chunks * dims.chunk)
 
 
 def _pull_table(ws: Dict[str, jnp.ndarray], dims: sp.SpmmDims) -> jnp.ndarray:
@@ -115,20 +126,40 @@ def acc_from_delta(delta: jnp.ndarray, n: int) -> Dict[str, jnp.ndarray]:
 
 def pull_pool_cvm(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
                   shape_slb: Tuple[int, int, int], use_cvm: bool = True,
-                  interpret: bool = False) -> jnp.ndarray:
+                  interpret: bool = False,
+                  crossing: str = "take") -> jnp.ndarray:
     """Fused pull + seqpool + CVM → pooled [B, S, 3 + D].
 
     Row 0 and the sentinel tile hold zeros, so padding occurrences and
     unseen keys contribute nothing — no length mask needed on the pull side.
+    crossing: sorted→canonical lowering (ops/crossing.py) — "take" gathers
+    by inv_perm, "sort" re-sorts keyed by perm (the destination index).
     """
+    from paddlebox_tpu.ops import crossing as cx
+    assert crossing in ("take", "sort"), crossing
     s, l, b = shape_slb
     d = ws["mf"].shape[1]
     rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
+    eff = plan_eff_dims(plan, dims)
     tab = _pull_table(ws, dims)
-    g = sp.gather_sorted(tab, rows2d, ch, tl, fg, dims,
+    g = sp.gather_sorted(tab, rows2d, ch, tl, fg, eff or dims,
                          interpret=interpret)              # [12, p_pad]
-    v = jnp.take(g.T[:dims.p], inv_perm, axis=0)           # canonical [p,12]
-    v = v.reshape(s, l, b, 3 + d + 1)
+    w = 3 + d + 1
+    if crossing == "sort":
+        if eff is not None:
+            # dropped (row-0) positions re-enter as leading zero columns —
+            # exactly the value row 0 holds
+            p0 = dims.p_pad - eff.p_pad
+            g = jnp.concatenate([jnp.zeros((w, p0), g.dtype), g], axis=1)
+        v = cx.permute_by_dest(tuple(g[:, :dims.p]), perm).T  # [p, 12]
+    elif eff is None:
+        v = jnp.take(g.T[:dims.p], inv_perm, axis=0)       # canonical [p,12]
+    else:
+        # trimmed plan: dropped positions (inv_perm < 0) were row-0
+        # occurrences whose pull value is exactly zero — clamp + mask
+        v = jnp.take(g.T, jnp.maximum(inv_perm, 0), axis=0)
+        v = v * (inv_perm >= 0).astype(v.dtype)[:, None]
+    v = v.reshape(s, l, b, w)
     return pool_cvm_values(v, use_cvm)
 
 
@@ -136,29 +167,56 @@ def push_and_update(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
                     idx_slb: jnp.ndarray, d_pooled: jnp.ndarray,
                     ins_cvm: jnp.ndarray, slot_ids: jnp.ndarray,
                     cfg: SparseSGDConfig,
-                    interpret: bool = False) -> Dict[str, jnp.ndarray]:
+                    interpret: bool = False,
+                    crossing: str = "take") -> Dict[str, jnp.ndarray]:
     """Merged push + sparse optimizer.
 
     d_pooled [B, S, 3+D] — cols 0,1 are ignored and replaced by the
     instance cvm (reference push semantics, box_wrapper_impl.h:373);
     ins_cvm [B, 2]; slot_ids [S].
+    crossing: canonical→sorted lowering (ops/crossing.py) — "take" gathers
+    by perm, "sort" re-sorts keyed by inv_perm (the destination index).
     """
+    from paddlebox_tpu.ops import crossing as cx
+    assert crossing in ("take", "sort"), crossing
     s, l, b = idx_slb.shape
     d = ws["mf"].shape[1]
     n = ws["show"].shape[0]
+    w = d + 4
     rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
+    eff = plan_eff_dims(plan, dims)
+    kd = eff or dims
 
     payload = push_payload(d_pooled, ins_cvm, slot_ids, (s, l, b))
-    flat = payload.reshape(dims.p, d + 4)
-    srt = jnp.take(flat, perm, axis=0)                     # sorted domain
-    srt = jnp.concatenate(
-        [srt, jnp.zeros((dims.p_pad - dims.p, d + 4), jnp.float32)])
+    flat = payload.reshape(dims.p, w)
+    if crossing == "sort":
+        # destination = this element's sorted position (shifted kept-domain
+        # position when trimmed: negatives sort first = dropped prefix)
+        srt_cm = cx.permute_by_dest(tuple(flat.T), inv_perm)   # [w, p]
+        if eff is not None:
+            srt_cm = srt_cm[:, dims.p_pad - eff.p_pad:]
+        pad = kd.p_pad - srt_cm.shape[1]
+        srt_cm = jnp.concatenate(
+            [srt_cm, jnp.zeros((w, pad), jnp.float32)], axis=1)
+    elif eff is None:
+        srt = jnp.take(flat, perm, axis=0)                 # sorted domain
+        srt_cm = jnp.concatenate(
+            [srt, jnp.zeros((dims.p_pad - dims.p, w), jnp.float32)]).T
+    else:
+        # trimmed plan: keep the suffix of the full bijection — dropped
+        # row-0 occurrences never scatter (row 0 is reserved,
+        # optimizer.py:17) and sentinel tail positions read canonical 0
+        # but land in the discarded sentinel tile
+        p0 = dims.p_pad - eff.p_pad
+        perm_k = jnp.concatenate(
+            [perm, jnp.zeros((dims.p_pad - dims.p,), jnp.int32)])[p0:]
+        srt_cm = jnp.take(flat, perm_k, axis=0).T
     # slot column: keep only each row's FIRST occurrence (plan mask), so the
     # scatter-sum returns that occurrence's slot exactly — no averaging, and
     # keys appearing under several slots resolve deterministically
     # (≙ the reference's per-key slot from its merge position,
     # box_wrapper.cu:417 PushMergeCopy)
-    srt = srt.at[:, d + 3].mul(first_occ)
-    delta = sp.scatter_add_sorted(srt.T, rows2d, ch, tl, fs, dims,
+    srt_cm = srt_cm.at[w - 1, :].mul(first_occ)
+    delta = sp.scatter_add_sorted(srt_cm, rows2d, ch, tl, fs, kd,
                                   interpret=interpret)     # [D+4, n_kernel]
     return sparse_opt.apply_push(ws, acc_from_delta(delta, n), cfg)

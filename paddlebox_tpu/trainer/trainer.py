@@ -103,6 +103,7 @@ class SparseTrainer:
         self._step_fn = None
         self._packed_step_fn = None
         self._packed_sig = None
+        self._mxu_crossing = ("take", "take")
         self._check_nan = flags.get_flags("check_nan_inf")
 
         if topology is not None:
@@ -199,12 +200,32 @@ class SparseTrainer:
         else:
             raise ValueError(f"unknown sparse_path {path!r}")
 
+    def _crossing_modes(self, s: int, l: int, b: int,
+                        eff_p_pad: int = None):
+        """Resolve the sorted<->canonical crossing lowering per direction
+        (ops/crossing.py): pull's take emits p canonical rows, push's take
+        emits only the trimmed width — auto mode times each on the live
+        backend once per geometry."""
+        from paddlebox_tpu.ops import crossing as cx
+        p = s * l * b
+        w = 3 + int(self.engine.ws["mf"].shape[1]) + 1
+        backend = jax.default_backend()
+        pull = cx.best_mode(p, p, w, backend)
+        push = cx.best_mode(eff_p_pad or p, p, w, backend)
+        return (pull, push)
+
     def _build_step(self):
         """Per-batch jitted step: takes [S, B, L] indices from the host
         packer (transposed + planned in-step)."""
         path = self._resolve_path()
         self._validate_path(path)
-        core = self._make_core(path)
+        crossing = ("take", "take")
+        if path == "mxu":
+            crossing = self._crossing_modes(
+                len(self.packer.sparse_slots), self.packer.capacity,
+                self.batch_size)
+        self._mxu_crossing = crossing
+        core = self._make_core(path, crossing)
 
         def step(ws, params, opt_state, auc_state, indices, lengths, dense,
                  labels, valid):
@@ -258,7 +279,7 @@ class SparseTrainer:
 
         return half
 
-    def _make_core(self, path: str):
+    def _make_core(self, path: str, crossing=("take", "take")):
         """Shared per-path step body, used by BOTH the per-batch and the
         pass-resident builders (single source of step semantics).
 
@@ -266,7 +287,8 @@ class SparseTrainer:
              labels, valid, plan) -> (ws, params, opt_state, auc_state,
              loss, preds[, d_params])
         idx_slb is [S, L, B]; plan is a precomputed sorted-spmm plan for the
-        mxu path (None → mask + build in-step).
+        mxu path (None → mask + build in-step); crossing = (pull, push)
+        sorted<->canonical lowerings for the mxu path (ops/crossing.py).
         """
         sgd_cfg = self.engine.config.sgd
         use_cvm = self.use_cvm
@@ -296,14 +318,16 @@ class SparseTrainer:
                                         < lengths[:, None, :], idx_slb, 0)
                     plan = mxu_path.build_plan(idx_slb, dims)
                 pooled = jax.lax.stop_gradient(mxu_path.pull_pool_cvm(
-                    ws, plan, dims, (s, l, b), use_cvm, interpret=interpret))
+                    ws, plan, dims, (s, l, b), use_cvm, interpret=interpret,
+                    crossing=crossing[0]))
                 (params, opt_state, auc_state, loss, preds, d_pooled,
                  d_params) = half(params, opt_state, auc_state, pooled,
                                   dense, labels, valid)
                 ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
                 ws = mxu_path.push_and_update(ws, plan, dims, idx_slb,
                                               d_pooled, ins_cvm, slot_ids,
-                                              sgd_cfg, interpret=interpret)
+                                              sgd_cfg, interpret=interpret,
+                                              crossing=crossing[1])
                 out = (ws, params, opt_state, auc_state, loss, preds)
                 return out + ((d_params,) if async_dense else ())
             return core
@@ -506,22 +530,45 @@ class SparseTrainer:
             }
         feed = pf.upload_pass(arrays, keep_host=keep, sharding=shardings)
         if self._resolve_path() == "mxu":
+            from paddlebox_tpu.ops import sorted_spmm as sp
             from paddlebox_tpu.ps import mxu_path
             n, s, l, b = feed.data["indices"].shape
             dims = mxu_path.make_dims(s * l * b,
                                       self.engine.ws["show"].shape[0])
-            pf.precompute_plans(feed, dims)
+            # padding occurrences (row 0) are dead kernel work — trim the
+            # plans to the widest batch's real-occurrence count (host
+            # lengths are exact, so this is a static bound for the pass)
+            per_batch = arrays.lengths.reshape(s, n, b).sum(axis=(0, 2))
+            eff = sp.trimmed_dims(dims, int(per_batch.max()))
+            pf.precompute_plans(feed, dims, eff)
         return feed
+
+    def _packed_signature(self, feed: PackedPassFeed):
+        """Trace-structural key of the packed step for a feed: path, plan
+        presence, async flag, crossing modes, table height, feed geometry.
+        Shared by the builder and the train loop so a stale comparison can
+        never skip (or force) a rebuild."""
+        path = self._resolve_path()
+        with_plans = feed.plans is not None
+        n, s, l, b = feed.data["indices"].shape
+        crossing = ("take", "take")
+        if path == "mxu":
+            eff_p_pad = None
+            if with_plans:
+                r = feed.plans["rows2d"].shape      # [N, n_chunks, 1, c]
+                eff_p_pad = int(r[1]) * int(r[3])
+            crossing = self._crossing_modes(s, l, b, eff_p_pad)
+        return (path, with_plans, self.async_dense is not None, crossing,
+                self.engine.ws["show"].shape[0], (n, s, l, b))
 
     def _build_packed_step(self, feed: PackedPassFeed):
         """Thin wrapper over the same per-path core as _build_step: slice
         the resident arrays (and the precomputed plan) by batch index."""
-        path = self._resolve_path()
+        sig = self._packed_signature(feed)
+        path, with_plans, _, crossing = sig[:4]
         self._validate_path(path)
-        core = self._make_core(path)
-        with_plans = feed.plans is not None
-        n, s, l, b = feed.data["indices"].shape
-        async_dense = self.async_dense is not None
+        self._mxu_crossing = crossing
+        core = self._make_core(path, crossing)
 
         def step(ws, params, opt_state, auc_state, i, data, plans):
             bt = slice_batch(data, i)
@@ -532,9 +579,8 @@ class SparseTrainer:
 
         self._packed_step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
         # n_rows + feed geometry drive retrace via shapes, but the plan
-        # presence/path/async flags are trace-structural — key them
-        self._packed_sig = (path, with_plans, async_dense,
-                            self.engine.ws["show"].shape[0], (n, s, l, b))
+        # presence/path/async/crossing flags are trace-structural — key them
+        self._packed_sig = sig
 
     def _train_packed(self, feed: PackedPassFeed,
                       progress=None) -> Dict[str, float]:
@@ -557,10 +603,8 @@ class SparseTrainer:
                     f"{feed.plan_dims}, but the working set now needs "
                     f"{cur} — rebuild the feed (build_pass_feed) after a "
                     "table resize")
-        sig = (path, feed.plans is not None, async_dense,
-               self.engine.ws["show"].shape[0],
-               tuple(feed.data["indices"].shape))
-        if self._packed_step_fn is None or self._packed_sig != sig:
+        if self._packed_step_fn is None \
+                or self._packed_sig != self._packed_signature(feed):
             self._build_packed_step(feed)
         engine = self.engine
         ws, params = engine.ws, self.params

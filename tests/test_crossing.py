@@ -1,0 +1,30 @@
+"""ops/crossing.py: permutation-as-sort lowering + auto-tune plumbing."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddlebox_tpu import flags
+from paddlebox_tpu.ops import crossing as cx
+
+
+def test_permute_by_dest_is_inverse_gather():
+    rng = np.random.default_rng(0)
+    n, w = 257, 5
+    dest = rng.permutation(n).astype(np.int32)
+    vals = rng.normal(0, 1, (w, n)).astype(np.float32)
+    out = np.asarray(cx.permute_by_dest(
+        tuple(jnp.asarray(vals)), jnp.asarray(dest)))
+    # out[:, dest[j]] == vals[:, j]
+    np.testing.assert_array_equal(out[:, dest], vals)
+
+
+def test_best_mode_cpu_and_flag_pin():
+    assert cx.best_mode(100, 100, 4, "cpu") == "take"
+    old = flags.get_flags("mxu_crossing")
+    try:
+        # the pin must take effect even after auto-tuned results are cached
+        flags.set_flags({"mxu_crossing": "sort"})
+        assert cx.best_mode(100, 100, 4, "cpu") == "sort"
+        assert cx.best_mode(100, 100, 4, "tpu") == "sort"
+    finally:
+        flags.set_flags({"mxu_crossing": old})

@@ -83,6 +83,59 @@ def test_push_matches_fast_path_adagrad():
             err_msg=f"field {k}")
 
 
+@pytest.mark.parametrize("crossing", ["take", "sort"])
+def test_trimmed_plan_matches_fast_path(crossing):
+    """A trimmed plan (padding occurrences dropped from the worklist) must
+    produce the same pooled pull and the same post-push working set as the
+    dense fast path — under both crossing lowerings (ops/crossing.py)."""
+    from paddlebox_tpu.ops import sorted_spmm as sp
+    n, D, S, L, B = 300, 4, 5, 3, 16
+    cfg = SparseSGDConfig(mf_create_thresholds=5.0)
+    ws = _make_ws(n, D)
+    idx, lengths, d_pooled, ins_cvm, slot_ids = _batch(n, S, L, B)
+    dims = sp.spmm_dims(S * L * B, n, chunk=8, tile=32)
+    n_real = int((np.asarray(idx) != 0).sum())
+    eff = sp.trimmed_dims(dims, n_real)
+    assert eff.p_pad < dims.p_pad, "batch must actually trim"
+    plan = mxu_path.build_plan(idx, dims, eff)
+
+    got = mxu_path.pull_pool_cvm(ws, plan, dims, (S, L, B), True,
+                                 interpret=True, crossing=crossing)
+    want = fast_path.pull_pool_cvm(ws, idx, lengths, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-3, rtol=1e-4)
+
+    got_ws = mxu_path.push_and_update(ws, plan, dims, idx, d_pooled,
+                                      ins_cvm, slot_ids, cfg, interpret=True,
+                                      crossing=crossing)
+    want_ws = fast_path.push_and_update(ws, idx, lengths, d_pooled, ins_cvm,
+                                        slot_ids, cfg)
+    for k in want_ws:
+        np.testing.assert_allclose(
+            np.asarray(got_ws[k]), np.asarray(want_ws[k]), atol=2e-3,
+            rtol=2e-4, err_msg=f"field {k}")
+
+
+def test_sort_crossing_matches_take_untrimmed():
+    """Untrimmed plans must also agree across crossing lowerings (the
+    per-batch step path builds plans in-step, always untrimmed)."""
+    n, D, S, L, B = 300, 4, 5, 3, 16
+    cfg = SparseSGDConfig(mf_create_thresholds=5.0)
+    ws = _make_ws(n, D)
+    idx, lengths, d_pooled, ins_cvm, slot_ids = _batch(n, S, L, B)
+    dims = mxu_path.make_dims(S * L * B, n)
+    plan = mxu_path.build_plan(idx, dims)
+    for fn, args in (
+            (mxu_path.pull_pool_cvm, (ws, plan, dims, (S, L, B), True)),
+            (mxu_path.push_and_update, (ws, plan, dims, idx, d_pooled,
+                                        ins_cvm, slot_ids, cfg))):
+        a = fn(*args, interpret=True, crossing="take")
+        b = fn(*args, interpret=True, crossing="sort")
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       atol=1e-5, rtol=1e-5)
+
+
 def test_push_matches_reference_path_all_optimizers():
     # the mxu accumulators must equal embedding.push_sparse_grads's, so any
     # optimizer rule (not just adagrad) composes with them

@@ -93,3 +93,57 @@ def test_non_multiple_sizes():
     # p not multiple of chunk, n_rows not multiple of tile
     rng = np.random.default_rng(4)
     _run(rng.integers(0, 77, 59).astype(np.int32), 77, chunk=8, tile=32)
+
+
+def test_trimmed_plan_matches_untrimmed():
+    """Trimming drops only row-0 (padding) occurrences: gather values match
+    the full dense reference after the mask, scatter deltas match on every
+    real row, untouched rows stay exactly zero."""
+    rng = np.random.default_rng(5)
+    p, n_rows, w, chunk, tile = 300, 200, 16, 8, 32
+    rows_np = rng.integers(1, n_rows, p).astype(np.int32)
+    rows_np[rng.random(p) < 0.4] = 0        # heavy padding fraction
+    dims = sp.spmm_dims(p, n_rows, chunk=chunk, tile=tile)
+    n_real = int((rows_np != 0).sum())
+    eff = sp.trimmed_dims(dims, n_real)
+    assert eff.p_pad < dims.p_pad
+    assert eff.p_pad % chunk == 0 and eff.n_work < dims.n_work
+
+    table = np.zeros((w, dims.n_kernel), np.float32)
+    # row 0 is the reserved zero row — the mask reproduces exactly that
+    table[:, 1:n_rows] = rng.normal(0, 1, (w, n_rows - 1)).astype(np.float32)
+    payload = rng.normal(0, 1, (w, p)).astype(np.float32)
+
+    rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = sp.build_plan(
+        jnp.asarray(rows_np), dims, eff)
+    assert rows2d.shape[0] == eff.n_chunks
+    assert perm.shape[0] == p and ch.shape[0] == eff.n_work
+    iv = np.asarray(inv_perm)
+    assert np.all(iv[rows_np != 0] >= 0), "a real occurrence was dropped"
+    assert np.all(iv < eff.p_pad)
+    # perm stays the full bijection: suffix = kept positions
+    p0 = dims.p_pad - eff.p_pad
+    perm_k = np.concatenate(
+        [np.asarray(perm), np.zeros(dims.p_pad - p, np.int64)])[p0:]
+
+    g = sp.gather_sorted(jnp.asarray(table), rows2d, ch, tl, fg, eff,
+                         interpret=True)
+    v = np.asarray(g).T[np.maximum(iv, 0)] * (iv >= 0)[:, None]
+    np.testing.assert_allclose(v, table[:, rows_np].T, atol=1e-4, rtol=1e-4)
+
+    srt = payload.T[perm_k.astype(np.int64)]     # [eff.p_pad, w]
+    d = sp.scatter_add_sorted(jnp.asarray(srt.T), rows2d, ch, tl, fs, eff,
+                              interpret=True)
+    ref = np.zeros((w, dims.n_kernel), np.float32)
+    np.add.at(ref.T, rows_np, payload.T)
+    np.testing.assert_allclose(np.asarray(d)[:, 1:n_rows], ref[:, 1:n_rows],
+                               atol=1e-3, rtol=1e-4)
+    untouched = np.setdiff1d(np.arange(1, n_rows), rows_np)
+    assert np.all(np.asarray(d)[:, untouched] == 0.0)
+
+
+def test_trimmed_dims_no_padding_degenerates():
+    # when every occurrence is real, trimming keeps everything
+    dims = sp.spmm_dims(256, 1000, chunk=8, tile=32)
+    eff = sp.trimmed_dims(dims, 256)
+    assert eff == dims
