@@ -1,0 +1,105 @@
+"""bench.py harness logic: watchdog, partial emission, JSON contract.
+
+The driver's only view of a round's performance is bench.py's LAST stdout
+line — these tests pin the contract the driver depends on: always exactly
+one parseable JSON object with metric/value/unit/vs_baseline, a watchdog
+that emits the best partial value instead of hanging, and non-finite
+floats sanitized to null.  Run in-process (module import, no subprocess)
+with the phase clock manipulated directly.
+"""
+
+import importlib.util
+import io
+import json
+import os
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    """A fresh bench module per test (module-level _STATE is global)."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _last_json(capture: io.StringIO):
+    lines = [ln for ln in capture.getvalue().splitlines() if ln.strip()]
+    assert lines, "bench printed nothing"
+    return json.loads(lines[-1])
+
+
+def test_emit_contract(bench, monkeypatch):
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    bench.emit(123.456, final=True, basis="end_to_end", stage="full")
+    line = _last_json(out)
+    assert line["metric"] == bench.METRIC
+    assert line["value"] == 123.5
+    assert line["unit"] == "examples/s"
+    assert line["vs_baseline"] == round(123.456 / 1e6, 4)
+    assert line["basis"] == "end_to_end"
+    assert bench._STATE["done"] is True
+
+
+def test_emit_sanitizes_non_finite(bench, monkeypatch):
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    bench.emit(0.0, final=True,
+               partial={"auc": float("nan"), "e2e": float("inf")})
+    line = _last_json(out)  # must parse under strict JSON
+    assert line["partial"]["auc"] is None
+    assert line["partial"]["e2e"] is None
+
+
+def test_best_prefers_e2e_over_smoke(bench):
+    bench.record(smoke_device_step=10.0)
+    assert bench._best() == 10.0
+    bench.record(device_step=50.0)
+    assert bench._best() == 50.0
+    bench.record(e2e=40.0)
+    assert bench._best() == 40.0   # e2e is the headline even if smaller
+
+
+def test_watchdog_emits_partial_on_expired_phase(bench, monkeypatch):
+    """A wedged phase must produce the best partial value + the phase name,
+    not a hang or a bare 0.0."""
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    exited = {}
+
+    def fake_exit(code):
+        exited["code"] = code
+        raise SystemExit                        # always escape the loop
+
+    monkeypatch.setattr(os, "_exit", fake_exit)
+    bench.record(device_step=473091.0)
+    bench.set_phase("full:e2e", budget_s=-1)    # already expired
+    with pytest.raises(SystemExit):
+        bench._watchdog()
+    line = _last_json(out)
+    assert line["value"] == 473091.0
+    assert "full:e2e" in line["error"]
+    assert line["last_phase"] == "full:e2e"
+    assert exited["code"] == 0
+
+
+def test_watchdog_respects_done_flag(bench, monkeypatch):
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    bench._STATE["done"] = True
+    t0 = time.time()
+    bench._watchdog()                           # returns promptly, no emit
+    assert time.time() - t0 < 10
+
+
+def test_phase_budget_capped_by_global_deadline(bench):
+    hard = bench.T0 + bench.TOTAL_BUDGET - 20
+    bench.set_phase("x", budget_s=10 ** 9)
+    assert bench._STATE["deadline"] <= hard
