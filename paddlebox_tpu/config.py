@@ -39,6 +39,11 @@ class DataFeedConfig:
     pipe_command: str = ""          # shell preprocessor (≙ pipe_command_)
     parser: str = "multi_slot"      # "multi_slot" | "slot_feasign"
     rand_seed: int = 0
+    # PV-merge rank_offset plane for rank-attention models
+    # (≙ DataFeedDesc.rank_offset, data_feed.cc:1851; built per batch by
+    # data/rank_offset.py — requires logkey-parsed cmatch/rank fields)
+    rank_offset: bool = False
+    max_rank: int = 3               # hardcoded 3 in the reference (:1858)
 
     def __post_init__(self):
         object.__setattr__(self, "slots", tuple(self.slots))

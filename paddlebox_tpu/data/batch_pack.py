@@ -36,6 +36,7 @@ class PackedBatch:
     num_real: int             # records before padding
     keys: Optional[np.ndarray] = None   # [S, B, L] uint64 raw feasigns
     ins_ids: Optional[list] = None      # [num_real] instance ids (for dump)
+    rank_offset: Optional[np.ndarray] = None  # [B, 1+2*max_rank] int32 (pv)
 
 
 class BatchPacker:
@@ -120,6 +121,13 @@ class BatchPacker:
         else:
             indices = np.zeros((S, B, L), dtype=np.int32)
 
+        rank_off = None
+        if self.config.rank_offset:
+            from paddlebox_tpu.data.rank_offset import build_rank_offset
+            rank_off = build_rank_offset(block.search_ids, block.cmatch,
+                                         block.rank, B,
+                                         self.config.max_rank)
+
         return PackedBatch(indices=indices, lengths=lengths, dense=dense,
                            labels=labels, valid=valid, num_real=n, keys=keys,
-                           ins_ids=block.ins_ids)
+                           ins_ids=block.ins_ids, rank_offset=rank_off)

@@ -49,19 +49,59 @@ class HostPassArrays:
     valid: np.ndarray      # [N*B] bool
     n_batches: int
     batch_size: int
-    num_real: int          # records before tail padding
+    num_real: int          # real record count (pass total)
     ins_ids: Optional[list] = None
+    # prebatched (pv-aligned) packs: per-batch real counts + prefix sums
+    # into the real-record order (dump/ins_ids addressing); None = records
+    # are densely packed and batch i holds rows [i*B, i*B + real_i)
+    batch_real: Optional[np.ndarray] = None   # [N] int64
+    batch_base: Optional[np.ndarray] = None   # [N] int64
+    rank_offset: Optional[np.ndarray] = None  # [N*B, 1+2*max_rank] int32
+
+    def real_range(self, i: int):
+        """(plane_row_lo, real_count, real_order_base) of batch i."""
+        if self.batch_real is not None:
+            return (i * self.batch_size, int(self.batch_real[i]),
+                    int(self.batch_base[i]))
+        lo = i * self.batch_size
+        return lo, max(0, min(self.batch_size, self.num_real - lo)), lo
 
 
 def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
               batch_size: int, label_slot="label",
-              key_mapper=None) -> HostPassArrays:
+              key_mapper=None, prebatched: bool = False) -> HostPassArrays:
     """Vectorized whole-pass pack: one call per slot, one key translation
-    for every occurrence in the pass (vs per-batch searchsorted loops)."""
+    for every occurrence in the pass (vs per-batch searchsorted loops).
+
+    prebatched: each input block IS one batch (≤ batch_size records, e.g.
+    pv-aligned cuts from dataset.batches) and lands at its own batch slot,
+    short batches padded — ≙ PadBoxSlotDataset's whole-pv batches feeding
+    SlotPaddleBoxDataFeed.  Otherwise blocks are concatenated and sliced
+    densely every batch_size records.
+    """
     packer = BatchPacker(feed_config, batch_size, label_slot)
-    merged = SlotRecordBlock.concat(list(blocks))
+    blocks = list(blocks)
+    if prebatched:
+        counts = [b.n for b in blocks]
+        over = [c for c in counts if c > batch_size]
+        if over:
+            raise ValueError(
+                f"prebatched block of {over[0]} records exceeds batch_size "
+                f"{batch_size}")
+        n_batches = max(1, len(blocks))
+        merged = SlotRecordBlock.concat(blocks)
+        pos = (np.concatenate(
+            [i * batch_size + np.arange(c) for i, c in enumerate(counts)])
+            if counts else np.zeros((0,), np.int64)).astype(np.int64)
+        batch_real = np.asarray(counts + [0] * (n_batches - len(counts)),
+                                np.int64)
+        batch_base = np.concatenate([[0], np.cumsum(batch_real)[:-1]])
+    else:
+        merged = SlotRecordBlock.concat(blocks)
+        n_batches = max(1, -(-merged.n // batch_size))
+        pos = slice(0, merged.n)   # contiguous writes on the dense path
+        batch_real = batch_base = None
     n = merged.n
-    n_batches = max(1, -(-n // batch_size))
     nb = n_batches * batch_size
     S, L = len(packer.sparse_slots), packer.capacity
 
@@ -81,15 +121,15 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
         # _pad_ragged zero-fills positions beyond each record's length, so
         # padding already lands on the reserved zero row — no re-mask pass
         padded, lens = packer._pad_ragged(values, offsets, L)
-        indices[si, :n] = padded
-        lengths[si, :n] = lens
+        indices[si, pos] = padded
+        lengths[si, pos] = lens
 
     dense = np.zeros((nb, packer.dense_dim), dtype=np.float32)
     col = 0
     for slot in packer.dense_slots:
         values, offsets = merged.float_slots[slot.name]
         padded, _ = packer._pad_ragged(values, offsets, slot.dim)
-        dense[:n, col:col + slot.dim] = padded
+        dense[pos, col:col + slot.dim] = padded
         col += slot.dim
 
     multi = np.zeros((nb, len(packer.label_slots)), np.float32)
@@ -99,15 +139,34 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
         if name in src:
             lv, lo = src[name]
             lp, _ = packer._pad_ragged(lv, lo, 1)
-            multi[:n, t] = lp[:, 0].astype(np.float32)
+            multi[pos, t] = lp[:, 0].astype(np.float32)
     labels = multi if len(packer.label_slots) > 1 else multi[:, 0]
 
     valid = np.zeros((nb,), dtype=bool)
-    valid[:n] = True
-    return HostPassArrays(indices=indices, lengths=lengths, dense=dense,
-                          labels=labels, valid=valid, n_batches=n_batches,
-                          batch_size=batch_size, num_real=n,
-                          ins_ids=merged.ins_ids)
+    valid[pos] = True
+
+    out = HostPassArrays(indices=indices, lengths=lengths, dense=dense,
+                         labels=labels, valid=valid, n_batches=n_batches,
+                         batch_size=batch_size, num_real=n,
+                         ins_ids=merged.ins_ids, batch_real=batch_real,
+                         batch_base=batch_base)
+    if feed_config.rank_offset:
+        # ≙ GetRankOffset per batch (data_feed.cc:1855) — batch-local row
+        # indices; meaningful under pv grouping (whole pvs per batch)
+        from paddlebox_tpu.data.rank_offset import build_rank_offset
+        cols = 2 * feed_config.max_rank + 1
+        out.rank_offset = np.full((nb, cols), -1, np.int32)
+        for i in range(n_batches):
+            lo, cnt, base = out.real_range(i)
+            if cnt == 0:
+                continue
+            sl = slice(base, base + cnt)
+            out.rank_offset[lo:lo + batch_size] = build_rank_offset(
+                None if merged.search_ids is None else merged.search_ids[sl],
+                None if merged.cmatch is None else merged.cmatch[sl],
+                None if merged.rank is None else merged.rank[sl],
+                batch_size, feed_config.max_rank)
+    return out
 
 
 @dataclasses.dataclass
@@ -157,6 +216,8 @@ def _relayout(d, N: int, B: int):
     }
     lbl = d["labels"]
     out["labels"] = lbl.reshape((N, B) + lbl.shape[1:])
+    if "rank_offset" in d:
+        out["rank_offset"] = d["rank_offset"].reshape(N, B, -1)
     return out
 
 
@@ -196,6 +257,7 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
             "dense": NamedSharding(mesh, P(spec)),
             "labels": NamedSharding(mesh, P(spec)),
             "valid": NamedSharding(mesh, P(spec)),
+            "rank_offset": NamedSharding(mesh, P(spec, None)),
         }
 
     def put(name, a):
@@ -210,6 +272,8 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
         "labels": put("labels", h.labels),
         "valid": put("valid", h.valid),
     }
+    if h.rank_offset is not None:
+        dev["rank_offset"] = put("rank_offset", h.rank_offset)
     data = _relayout(dev, N, B)
     if sharding is not None:
         data = {k: jax.device_put(v, sharding[k]) if k in sharding else v

@@ -1,0 +1,75 @@
+"""PV-merge rank_offset assembly — GetRankOffset / CopyRankOffset equivalent.
+
+≙ PaddleBoxDataFeed::GetRankOffset (data_feed.cc:1855-1903) + the device
+copy CopyRankOffset (data_feed.cu:1371): under PV merge (records grouped by
+search_id), each batch carries a [B, 1 + 2*max_rank] int32 plane consumed
+by rank-attention models (ops/rank_attention.py):
+
+  col 0        = own rank, or -1 (valid iff cmatch in {222, 223} and
+                 1 <= rank <= max_rank — data_feed.cc:1873)
+  col 2m+1/2m+2 = for each peer rank m+1 present in the pv: that peer's
+                 rank and its BATCH ROW index; -1 where absent.  When a pv
+                 holds several ads with the same rank the LAST one wins
+                 (the reference's overwrite loop, data_feed.cc:1880-1895).
+
+TPU-first: the reference fills the matrix with a per-pv nested loop on
+host then memcpys to GPU; here the whole batch is assembled with
+vectorized numpy (group runs from the pv-sorted order, last-wins via
+duplicate fancy assignment) and ships with the rest of the pass pack.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+CMATCH_RANKED = (222, 223)      # data_feed.cc:1873 — join-phase ad cmatches
+
+
+def build_rank_offset(search_ids: Optional[np.ndarray],
+                      cmatch: Optional[np.ndarray],
+                      rank: Optional[np.ndarray],
+                      n: int, max_rank: int = 3) -> np.ndarray:
+    """[n, 1 + 2*max_rank] int32 for one batch of pv-contiguous records.
+
+    search_ids/cmatch/rank: per-record arrays for the batch's REAL records
+    (may be shorter than n — the tail padding rows stay all -1), or None
+    (no pv/logkey data parsed → all -1, matching a feed without pv merge).
+    """
+    col = 2 * max_rank + 1
+    out = np.full((n, col), -1, np.int32)
+    if search_ids is None or cmatch is None or rank is None or not len(
+            search_ids):
+        return out
+    m = len(search_ids)
+    valid = np.zeros((m,), bool)
+    for c in CMATCH_RANKED:
+        valid |= cmatch == c
+    valid &= (rank >= 1) & (rank <= max_rank)
+    r = np.where(valid, rank, -1).astype(np.int32)
+    out[:m, 0] = r
+
+    # pv groups are contiguous runs of equal search_id (preprocess_instance
+    # sorts stable by search_id, dataset.py:199 ≙ PreprocessInstance)
+    new_group = np.empty((m,), bool)
+    new_group[0] = True
+    np.not_equal(search_ids[1:], search_ids[:-1], out=new_group[1:])
+    group_id = np.cumsum(new_group) - 1                   # [m]
+    n_groups = int(group_id[-1]) + 1
+
+    # per (group, rank) slot: batch row of the LAST valid ad with that rank
+    # (duplicate fancy assignment keeps the last occurrence — the
+    # reference's overwrite order)
+    g_row = np.full((n_groups, max_rank), -1, np.int64)
+    vk = np.nonzero(valid)[0]
+    g_row[group_id[vk], r[vk] - 1] = vk
+
+    rows = np.nonzero(r > 0)[0]                           # own rank valid
+    peers = g_row[group_id[rows]]                         # [R, max_rank]
+    present = peers >= 0
+    out[rows[:, None], 1 + 2 * np.arange(max_rank)[None]] = np.where(
+        present, np.arange(1, max_rank + 1)[None], -1)
+    out[rows[:, None], 2 + 2 * np.arange(max_rank)[None]] = peers.astype(
+        np.int32)
+    return out

@@ -1,0 +1,58 @@
+"""Rank-attention CTR model — the PV-learning join-phase model shape.
+
+≙ the PaddleBox models that consume the PV-merge `rank_offset` feed
+(data_feed.cc:1855 GetRankOffset) through the rank_attention op
+(operators/rank_attention_op.cu): each ad attends over the other ads of
+its page view with a parameter block selected by the (own rank, peer
+rank) pair, and the attention output joins the MLP input.
+
+Declares ``extra_inputs = ("rank_offset",)`` — the trainer feeds the
+batch's rank_offset plane as a keyword argument (trainer.py extras
+plumbing), on both the per-batch and the pass-resident paths.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import init_mlp, mlp_apply
+from paddlebox_tpu.ops.rank_attention import rank_attention
+
+
+class RankAttentionCTR:
+    extra_inputs = ("rank_offset",)
+
+    def __init__(self, num_slots: int, emb_width: int, dense_dim: int,
+                 att_out: int = 32, max_rank: int = 3,
+                 hidden: Sequence[int] = (128, 64)):
+        self.num_slots = num_slots
+        self.emb_width = emb_width
+        self.dense_dim = dense_dim
+        self.att_out = att_out
+        self.max_rank = max_rank
+        self.hidden = tuple(hidden)
+        self.in_col = num_slots * emb_width
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        in_dim = self.in_col + self.att_out + self.dense_dim + 1
+        return {
+            "mlp": init_mlp(k1, (in_dim,) + self.hidden + (1,)),
+            # [max_rank*max_rank*in_col, att_out] block layout — the
+            # `start = lower*max_rank + faster` addressing of
+            # rank_attention.cu.h:90
+            "rank_param": jax.random.uniform(
+                k2, (self.max_rank * self.max_rank * self.in_col,
+                     self.att_out), jnp.float32, -0.01, 0.01),
+        }
+
+    def apply(self, params, pooled: jnp.ndarray, dense: jnp.ndarray,
+              rank_offset: jnp.ndarray) -> jnp.ndarray:
+        att, ins_rank = rank_attention(
+            pooled, rank_offset, params["rank_param"], self.max_rank)
+        x = jnp.concatenate(
+            [pooled, att, dense, ins_rank[:, None]], axis=-1)
+        return mlp_apply(params["mlp"], x)[:, 0]

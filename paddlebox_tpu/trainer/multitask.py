@@ -57,7 +57,7 @@ class MultiTaskSparseTrainer(SparseTrainer):
         n_tasks = self.n_tasks
 
         def step(ws, params, opt_state, auc_state, indices, lengths, dense,
-                 labels, valid):
+                 labels, valid, extras=None):
             emb = jax.lax.stop_gradient(embedding.pull_sparse(ws, indices))
             # show=1, click=task-0 label (the CTR head feeds the PS counters)
             ins_cvm = jnp.stack(

@@ -78,6 +78,29 @@ class SparseTrainer:
                 m[i, 3 + engine.config.slot_mf_dim(int(sid)):] = 0.0
             self._dym_mask = jnp.asarray(m)
 
+        # models declaring extra feed inputs (e.g. RankAttentionCTR's
+        # rank_offset) must have the feed actually produce them — fail at
+        # construction, not with an in-trace TypeError mid-pass
+        need = set(getattr(model, "extra_inputs", ()))
+        unknown = need - {"rank_offset"}
+        if unknown:
+            raise ValueError(
+                f"model.extra_inputs {sorted(unknown)} are not feed planes "
+                "this trainer can supply (supported: rank_offset)")
+        if "rank_offset" in need:
+            if not feed_config.rank_offset:
+                raise ValueError(
+                    "model requires the rank_offset plane — set "
+                    "DataFeedConfig(rank_offset=True) (and call "
+                    "dataset.preprocess_instance() so batches hold whole "
+                    "page views)")
+            mr = getattr(model, "max_rank", None)
+            if mr is not None and mr != feed_config.max_rank:
+                raise ValueError(
+                    f"model.max_rank={mr} != DataFeedConfig.max_rank="
+                    f"{feed_config.max_rank}: rank_param blocks would be "
+                    "mis-addressed")
+
         self.dense_tx = dense_optimizer or optax.adam(1e-3)
         self.params = model.init(jax.random.PRNGKey(seed))
         self.opt_state = self.dense_tx.init(self.params)
@@ -228,10 +251,10 @@ class SparseTrainer:
         core = self._make_core(path, crossing)
 
         def step(ws, params, opt_state, auc_state, indices, lengths, dense,
-                 labels, valid):
+                 labels, valid, extras):
             idx_slb = jnp.transpose(indices, (0, 2, 1))    # [S, L, B]
             return core(ws, params, opt_state, auc_state, idx_slb, lengths,
-                        dense, labels, valid, None)
+                        dense, labels, valid, None, extras)
 
         self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
@@ -247,8 +270,12 @@ class SparseTrainer:
 
         apply_dense = self.async_dense is None
 
-        def half(params, opt_state, auc_state, pooled, dense, labels, valid):
+        def half(params, opt_state, auc_state, pooled, dense, labels, valid,
+                 extras=None):
             B = pooled.shape[0]
+            kw = {k: extras[k]
+                  for k in getattr(model, "extra_inputs", ())} \
+                if extras else {}
 
             def loss_fn(p, pooled_in):
                 if dym_mask is not None:
@@ -259,9 +286,9 @@ class SparseTrainer:
                     p_c = jax.tree.map(lambda a: a.astype(jnp.bfloat16), p)
                     logits = model.apply(
                         p_c, x.astype(jnp.bfloat16),
-                        dense.astype(jnp.bfloat16)).astype(jnp.float32)
+                        dense.astype(jnp.bfloat16), **kw).astype(jnp.float32)
                 else:
-                    logits = model.apply(p, x, dense)
+                    logits = model.apply(p, x, dense, **kw)
                 w = valid.astype(jnp.float32)
                 per = optax.sigmoid_binary_cross_entropy(logits, labels)
                 loss = jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
@@ -304,7 +331,7 @@ class SparseTrainer:
             half = self._pooled_dense_half()
 
             def core(ws, params, opt_state, auc_state, idx_slb, lengths,
-                     dense, labels, valid, plan):
+                     dense, labels, valid, plan, extras=None):
                 s, l, b = idx_slb.shape
                 # geometry from the *traced* working set, so per-pass table
                 # resizes retrace with correct dims (and correct sentinel)
@@ -322,7 +349,7 @@ class SparseTrainer:
                     crossing=crossing[0]))
                 (params, opt_state, auc_state, loss, preds, d_pooled,
                  d_params) = half(params, opt_state, auc_state, pooled,
-                                  dense, labels, valid)
+                                  dense, labels, valid, extras)
                 ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
                 ws = mxu_path.push_and_update(ws, plan, dims, idx_slb,
                                               d_pooled, ins_cvm, slot_ids,
@@ -361,7 +388,7 @@ class SparseTrainer:
             tbl_spec2 = P(tbl_axes, None)
 
             def core(ws, params, opt_state, auc_state, idx_slb, lengths,
-                     dense, labels, valid, plan):
+                     dense, labels, valid, plan, extras=None):
                 s, l, b = idx_slb.shape
                 d = ws["mf"].shape[1]
                 n_rows = ws["show"].shape[0]
@@ -393,7 +420,7 @@ class SparseTrainer:
                     mxu_path.pool_cvm_values(v, use_cvm))
                 (params, opt_state, auc_state, loss, preds, d_pooled,
                  d_params) = half(params, opt_state, auc_state, pooled,
-                                  dense, labels, valid)
+                                  dense, labels, valid, extras)
                 ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
                 payload = mxu_path.push_payload(d_pooled, ins_cvm, slot_ids,
                                                 (s, l, b))   # [S,L,B,D+4]
@@ -429,12 +456,12 @@ class SparseTrainer:
             half = self._pooled_dense_half()
 
             def core(ws, params, opt_state, auc_state, idx_slb, lengths,
-                     dense, labels, valid, plan):
+                     dense, labels, valid, plan, extras=None):
                 pooled = jax.lax.stop_gradient(
                     fast_path.pull_pool_cvm(ws, idx_slb, lengths, use_cvm))
                 (params, opt_state, auc_state, loss, preds, d_pooled,
                  d_params) = half(params, opt_state, auc_state, pooled,
-                                  dense, labels, valid)
+                                  dense, labels, valid, extras)
                 ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
                 ws = fast_path.push_and_update(ws, idx_slb, lengths,
                                                d_pooled, ins_cvm, slot_ids,
@@ -447,11 +474,14 @@ class SparseTrainer:
         dym_mask = self._dym_mask
 
         def core(ws, params, opt_state, auc_state, idx_slb, lengths, dense,
-                 labels, valid, plan):
+                 labels, valid, plan, extras=None):
             indices = jnp.transpose(idx_slb, (0, 2, 1))    # [S, B, L]
             # 1. pull (≙ PullSparseCaseGPU box_wrapper_impl.h:25)
             emb = jax.lax.stop_gradient(embedding.pull_sparse(ws, indices))
             ins_cvm = jnp.stack([jnp.ones_like(labels), labels], axis=1)
+            kw = {k: extras[k]
+                  for k in getattr(model, "extra_inputs", ())} \
+                if extras else {}
 
             # 2-3. forward + backward over (dense params, pulled embeddings)
             def loss_fn(p, e):
@@ -468,9 +498,9 @@ class SparseTrainer:
                         lambda a: a.astype(jnp.bfloat16), p)
                     logits = model.apply(
                         p_c, pooled.astype(jnp.bfloat16),
-                        dense.astype(jnp.bfloat16)).astype(jnp.float32)
+                        dense.astype(jnp.bfloat16), **kw).astype(jnp.float32)
                 else:
-                    logits = model.apply(p, pooled, dense)
+                    logits = model.apply(p, pooled, dense, **kw)
                 w = valid.astype(jnp.float32)
                 per = optax.sigmoid_binary_cross_entropy(logits, labels)
                 loss = jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
@@ -508,11 +538,19 @@ class SparseTrainer:
         time — the train loop then touches no per-batch host work."""
         from paddlebox_tpu.data import pass_feed as pf
         assert self.engine.ws is not None, "engine lifecycle must run first"
+        self._require_pv_for_rank(dataset)
         label = (self.packer.label_slots
                  if len(self.packer.label_slots) > 1 else self.packer.label_slot)
-        arrays = pf.pack_pass(dataset.get_blocks(), self.packer.config,
+        # pv-grouped datasets batch on page-view boundaries (a pv trains as
+        # one unit, ≙ PadBoxSlotDataset whole-pv batches) — feed those cuts
+        # to the pass pack instead of dense slicing
+        prebatched = bool(getattr(dataset, "_pv_grouped", False))
+        blocks = (list(dataset.batches(self.batch_size)) if prebatched
+                  else dataset.get_blocks())
+        arrays = pf.pack_pass(blocks, self.packer.config,
                               self.batch_size, label,
-                              key_mapper=self.engine.mapper)
+                              key_mapper=self.engine.mapper,
+                              prebatched=prebatched)
         keep = keep_host or bool(self.trainer_config.dump_path)
         shardings = None
         if self.topology is not None:
@@ -528,6 +566,8 @@ class SparseTrainer:
                            else t.sharding(None, dp, None)),
                 "valid": t.sharding(None, dp),
             }
+            if arrays.rank_offset is not None:
+                shardings["rank_offset"] = t.sharding(None, dp, None)
         feed = pf.upload_pass(arrays, keep_host=keep, sharding=shardings)
         if self._resolve_path() == "mxu":
             from paddlebox_tpu.ops import sorted_spmm as sp
@@ -542,6 +582,19 @@ class SparseTrainer:
             eff = sp.trimmed_dims(dims, int(per_batch.max()))
             pf.precompute_plans(feed, dims, eff)
         return feed
+
+    def _require_pv_for_rank(self, dataset) -> None:
+        """rank_offset is only meaningful when every batch holds WHOLE page
+        views (the reference emits it exclusively under pv merge) — a pv
+        split across dense batch cuts would silently see only its
+        fragment's peers, so refuse loudly instead."""
+        if self.packer.config.rank_offset \
+                and not getattr(dataset, "_pv_grouped", False):
+            raise ValueError(
+                "DataFeedConfig(rank_offset=True) requires pv-grouped "
+                "batches — call dataset.preprocess_instance() before "
+                "training (≙ GetRankOffset's whole-pv batches, "
+                "data_feed.cc:1855)")
 
     def _packed_signature(self, feed: PackedPassFeed):
         """Trace-structural key of the packed step for a feed: path, plan
@@ -573,9 +626,12 @@ class SparseTrainer:
         def step(ws, params, opt_state, auc_state, i, data, plans):
             bt = slice_batch(data, i)
             plan = plan_tuple(slice_batch(plans, i)) if with_plans else None
+            extras = {k: bt[k] for k in bt
+                      if k not in ("indices", "lengths", "dense", "labels",
+                                   "valid")}
             return core(ws, params, opt_state, auc_state, bt["indices"],
                         bt["lengths"], bt["dense"], bt["labels"],
-                        bt["valid"], plan)
+                        bt["valid"], plan, extras)
 
         self._packed_step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
         # n_rows + feed geometry drive retrace via shapes, but the plan
@@ -641,14 +697,13 @@ class SparseTrainer:
                     raise FloatingPointError(f"NaN/Inf loss at batch {i}")
                 if dump_file is not None:
                     h = feed.host
-                    lo = i * feed.batch_size
-                    hi = min(lo + feed.batch_size, feed.num_real)
-                    if hi > lo:
-                        p = np.asarray(preds)[:hi - lo]
-                        lbl = np.asarray(h.labels[lo:hi])
-                        ids = (h.ins_ids[lo:hi] if h.ins_ids
-                               else [""] * (hi - lo))
-                        for j in range(hi - lo):
+                    lo, cnt, base = h.real_range(i)
+                    if cnt:
+                        p = np.asarray(preds)[:cnt]
+                        lbl = np.asarray(h.labels[lo:lo + cnt])
+                        ids = (h.ins_ids[base:base + cnt] if h.ins_ids
+                               else [""] * cnt)
+                        for j in range(cnt):
                             dump_file.write(
                                 f"{ids[j]}\t{lbl[j]:g}\t{p[j]:.6f}\n")
                 losses.append(loss)
@@ -691,8 +746,12 @@ class SparseTrainer:
     def _put_batch(self, batch: PackedBatch):
         arrs = (batch.indices, batch.lengths, batch.dense, batch.labels,
                 batch.valid)
+        extras = {}
+        if batch.rank_offset is not None:
+            extras["rank_offset"] = batch.rank_offset
         if self._batch_sharding is None:
-            return tuple(jnp.asarray(a) for a in arrs)
+            return tuple(jnp.asarray(a) for a in arrs) + (
+                {k: jnp.asarray(v) for k, v in extras.items()},)
         out = []
         for i, a in enumerate(arrs):
             if i == 0:  # [S,B,L] — batch dim 1
@@ -702,7 +761,9 @@ class SparseTrainer:
             else:
                 sh = self._batch_sharding
             out.append(jax.device_put(a, sh))
-        return tuple(out)
+        ex_sh = self.topology.sharding(("dp", "sharding"), None)
+        return tuple(out) + (
+            {k: jax.device_put(v, ex_sh) for k, v in extras.items()},)
 
     def train_pass(self, dataset: SlotDataset, prefetch: int = 4,
                    pack_threads: int = 1,
@@ -723,6 +784,7 @@ class SparseTrainer:
         """
         if isinstance(dataset, PackedPassFeed):
             return self._train_packed(dataset, progress)
+        self._require_pv_for_rank(dataset)
         if self._step_fn is None:
             self._build_step()
         engine = self.engine
