@@ -225,23 +225,24 @@ class SlotDataset:
     def get_blocks(self) -> List[SlotRecordBlock]:
         return self._blocks
 
-    def batches(self, batch_size: int, drop_last: bool = False
-                ) -> Iterator[SlotRecordBlock]:
-        """Yield fixed-size record batches; the tail short batch is yielded
-        unless drop_last (the device step pads it to capacity anyway).
-
-        After preprocess_instance(), cuts land on page-view boundaries
-        (short batches are padded by the trainer's valid mask) so a PV
-        trains as one unit."""
-        merged = SlotRecordBlock.concat(self._blocks)
-        if getattr(self, "_pv_grouped", False) \
-                and merged.search_ids is not None and merged.n:
-            sid = merged.search_ids
+    def batch_bounds(self, batch_size: int, drop_last: bool = False
+                     ) -> List[tuple]:
+        """(start, stop) record ranges of each batch over the concatenated
+        block order — pv-aligned after preprocess_instance().  Copies NO
+        slot data (only search_ids are concatenated), so pass-scoped
+        packers can batch the merged block without a slice/re-concat
+        round-trip."""
+        n = sum(b.n for b in self._blocks)
+        sids = [b.search_ids for b in self._blocks]
+        out = []
+        if getattr(self, "_pv_grouped", False) and n \
+                and all(s is not None for s in sids):
+            sid = sids[0] if len(sids) == 1 else np.concatenate(sids)
             # pv start positions (records are pv-sorted)
             pv_starts = np.concatenate(
-                [[0], np.nonzero(sid[1:] != sid[:-1])[0] + 1, [merged.n]])
+                [[0], np.nonzero(sid[1:] != sid[:-1])[0] + 1, [n]])
             start_i = 0
-            while pv_starts[start_i] < merged.n:
+            while pv_starts[start_i] < n:
                 start = int(pv_starts[start_i])
                 # furthest pv boundary within batch_size of start
                 stop_i = int(np.searchsorted(pv_starts,
@@ -253,14 +254,26 @@ class SlotDataset:
                         f"exceeds batch_size {batch_size} — raise the "
                         "batch size or skip preprocess_instance")
                 stop = int(pv_starts[stop_i])
-                if stop - start < batch_size and drop_last \
-                        and stop == merged.n:
-                    return
-                yield merged.slice(start, stop)
+                if not (stop - start < batch_size and drop_last
+                        and stop == n):
+                    out.append((start, stop))
                 start_i = stop_i
-            return
-        for start in range(0, merged.n, batch_size):
-            stop = min(start + batch_size, merged.n)
+            return out
+        for start in range(0, n, batch_size):
+            stop = min(start + batch_size, n)
             if stop - start < batch_size and drop_last:
-                return
+                break
+            out.append((start, stop))
+        return out
+
+    def batches(self, batch_size: int, drop_last: bool = False
+                ) -> Iterator[SlotRecordBlock]:
+        """Yield fixed-size record batches; the tail short batch is yielded
+        unless drop_last (the device step pads it to capacity anyway).
+
+        After preprocess_instance(), cuts land on page-view boundaries
+        (short batches are padded by the trainer's valid mask) so a PV
+        trains as one unit."""
+        merged = SlotRecordBlock.concat(self._blocks)
+        for start, stop in self.batch_bounds(batch_size, drop_last):
             yield merged.slice(start, stop)

@@ -69,27 +69,48 @@ class HostPassArrays:
 
 def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
               batch_size: int, label_slot="label",
-              key_mapper=None, prebatched: bool = False) -> HostPassArrays:
+              key_mapper=None, prebatched: bool = False,
+              batch_counts: Optional[Sequence[int]] = None
+              ) -> HostPassArrays:
     """Vectorized whole-pass pack: one call per slot, one key translation
     for every occurrence in the pass (vs per-batch searchsorted loops).
 
     prebatched: each input block IS one batch (≤ batch_size records, e.g.
     pv-aligned cuts from dataset.batches) and lands at its own batch slot,
     short batches padded — ≙ PadBoxSlotDataset's whole-pv batches feeding
-    SlotPaddleBoxDataFeed.  Otherwise blocks are concatenated and sliced
-    densely every batch_size records.
+    SlotPaddleBoxDataFeed.  batch_counts: same semantics but the cuts are
+    given as per-batch record counts over the CONCATENATED block order
+    (dataset.batch_bounds) — no per-batch block copies needed.  Otherwise
+    blocks are concatenated and sliced densely every batch_size records.
     """
     packer = BatchPacker(feed_config, batch_size, label_slot)
     blocks = list(blocks)
-    if prebatched:
+    merged = SlotRecordBlock.concat(blocks)
+    if batch_counts is not None:
+        counts = [int(c) for c in batch_counts]
+        if sum(counts) != merged.n:
+            raise ValueError(
+                f"batch_counts sum {sum(counts)} != {merged.n} records")
+    elif prebatched:
         counts = [b.n for b in blocks]
+    else:
+        counts = None
+    if feed_config.rank_offset and counts is None:
+        # the plane builder treats each batch slice as whole page views; a
+        # pv split across dense cuts would silently attend over fragment
+        # peers — every entry point inherits this guard, not just the
+        # trainer (≙ GetRankOffset only runs under pv merge,
+        # data_feed.cc:1855)
+        raise ValueError(
+            "rank_offset=True requires pv-aligned batches: pass "
+            "prebatched blocks or batch_counts (dataset.batch_bounds)")
+    if counts is not None:
         over = [c for c in counts if c > batch_size]
         if over:
             raise ValueError(
                 f"prebatched block of {over[0]} records exceeds batch_size "
                 f"{batch_size}")
-        n_batches = max(1, len(blocks))
-        merged = SlotRecordBlock.concat(blocks)
+        n_batches = max(1, len(counts))
         pos = (np.concatenate(
             [i * batch_size + np.arange(c) for i, c in enumerate(counts)])
             if counts else np.zeros((0,), np.int64)).astype(np.int64)
@@ -97,7 +118,6 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
                                 np.int64)
         batch_base = np.concatenate([[0], np.cumsum(batch_real)[:-1]])
     else:
-        merged = SlotRecordBlock.concat(blocks)
         n_batches = max(1, -(-merged.n // batch_size))
         pos = slice(0, merged.n)   # contiguous writes on the dense path
         batch_real = batch_base = None

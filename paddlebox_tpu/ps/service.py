@@ -82,6 +82,11 @@ class PSServer:
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
+        # keyed cross-worker array allreduce (metric aggregation —
+        # ≙ fleet.metrics gloo all_reduce of stat_pos/stat_neg,
+        # fleet/metrics/metric.py:144)
+        self._reduce_cv = threading.Condition()
+        self._reduces: Dict[str, Dict] = {}
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -205,6 +210,53 @@ class PSServer:
                             self._barrier_count -= 1
                         raise
             return {"ok": True}
+        if cmd == "allreduce":
+            # keyed sum-allreduce of named arrays across `world` callers:
+            # the exact distributed-metrics primitive (global AUC = AUC of
+            # the SUMMED pos/neg bucket tables, ≙ fleet.metrics.auc,
+            # fleet/metrics/metric.py:144).  Each key is one collective;
+            # last reader cleans up, so keys are reusable across passes.
+            key, world = req["key"], int(req["world"])
+            with self._reduce_cv:
+                st = self._reduces.setdefault(
+                    key, {"sum": None, "count": 0, "readers": 0,
+                          "done": False})
+                if st["done"]:
+                    raise RuntimeError(
+                        f"allreduce key {key!r} still draining readers — "
+                        "use a fresh key per collective (e.g. suffix the "
+                        "pass id)")
+                if st["sum"] is None:
+                    st["sum"] = dict(req["arrs"])
+                else:
+                    if set(st["sum"]) != set(req["arrs"]):
+                        raise ValueError(
+                            f"allreduce key {key!r}: participants disagree "
+                            f"on array names ({sorted(st['sum'])} vs "
+                            f"{sorted(req['arrs'])})")
+                    st["sum"] = {k: st["sum"][k] + v
+                                 for k, v in req["arrs"].items()}
+                st["count"] += 1
+                if st["count"] >= world:
+                    st["done"] = True
+                    self._reduce_cv.notify_all()
+                else:
+                    while not st["done"]:
+                        if not self._reduce_cv.wait(timeout=60):
+                            if st["done"]:
+                                break     # completed as the clock expired
+                            # roll back the WHOLE contribution (count AND
+                            # the summed arrays) so a retry on the same
+                            # key cannot double-count this worker
+                            st["count"] -= 1
+                            st["sum"] = {k: st["sum"][k] - v
+                                         for k, v in req["arrs"].items()}
+                            raise TimeoutError("ps allreduce timeout")
+                result = st["sum"]
+                st["readers"] += 1
+                if st["readers"] >= world:
+                    del self._reduces[key]
+            return {"ok": True, "arrs": result}
         return {"ok": False, "error": f"unknown cmd {cmd}"}
 
     def shutdown(self) -> None:
@@ -311,6 +363,15 @@ class PSClient:
         # server side always resolves (release or rollback) first
         self._call({"cmd": "barrier", "world": world}, retry=False,
                    timeout=timeout)
+
+    def allreduce(self, arrs: Dict[str, np.ndarray], world: int, key: str,
+                  timeout: float = 120) -> Dict[str, np.ndarray]:
+        """Sum the named arrays across `world` workers (every caller gets
+        the same result).  Non-idempotent like barrier — no retry.  Use a
+        fresh key per collective (e.g. f"auc-{pass_id}")."""
+        out = self._call({"cmd": "allreduce", "key": key, "world": world,
+                          "arrs": dict(arrs)}, retry=False, timeout=timeout)
+        return out["arrs"]
 
 
 class RemoteTableAdapter:

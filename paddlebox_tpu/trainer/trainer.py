@@ -542,15 +542,18 @@ class SparseTrainer:
         label = (self.packer.label_slots
                  if len(self.packer.label_slots) > 1 else self.packer.label_slot)
         # pv-grouped datasets batch on page-view boundaries (a pv trains as
-        # one unit, ≙ PadBoxSlotDataset whole-pv batches) — feed those cuts
-        # to the pass pack instead of dense slicing
-        prebatched = bool(getattr(dataset, "_pv_grouped", False))
-        blocks = (list(dataset.batches(self.batch_size)) if prebatched
-                  else dataset.get_blocks())
-        arrays = pf.pack_pass(blocks, self.packer.config,
+        # one unit, ≙ PadBoxSlotDataset whole-pv batches) — hand the pass
+        # pack the cut COUNTS over the merged order (batch_bounds copies no
+        # slot data; slicing + re-concatenating blocks would copy the pass
+        # twice)
+        counts = None
+        if getattr(dataset, "_pv_grouped", False):
+            counts = [hi - lo
+                      for lo, hi in dataset.batch_bounds(self.batch_size)]
+        arrays = pf.pack_pass(dataset.get_blocks(), self.packer.config,
                               self.batch_size, label,
                               key_mapper=self.engine.mapper,
-                              prebatched=prebatched)
+                              batch_counts=counts)
         keep = keep_host or bool(self.trainer_config.dump_path)
         shardings = None
         if self.topology is not None:

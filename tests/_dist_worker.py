@@ -93,8 +93,20 @@ def main():
         out = trainer.train_pass(ds)
         engine.end_pass()
         client.barrier(world)      # pass deltas all merged before next pull
+        # EXACT global metrics: allreduce the bucket tables through the PS
+        # (≙ fleet.metrics.auc) — every rank must report the same value
+        if world > 1:
+            from paddlebox_tpu.metrics.auc import (AucCalculator,
+                                                   allreduce_auc_state)
+            g = allreduce_auc_state(trainer.auc_state, client, world,
+                                    key=f"auc-{p}")
+            calc = AucCalculator(10_000)
+            calc.merge_device_state(g)
+            gauc = calc.compute()["auc"]
+        else:
+            gauc = out["auc"]
         results.append({"loss": out["loss"], "auc": out["auc"],
-                        "batches": out["batches"]})
+                        "gauc": gauc, "batches": out["batches"]})
         ds.release_memory()
 
     with open(os.environ["DW_OUT"] + f".rank{rank}", "w") as f:

@@ -139,6 +139,26 @@ def test_auto_resolves_to_mxu_at_bench_geometry():
     assert tr._resolve_path() == "reference"
 
 
+def test_feed_plans_are_trimmed_when_lengths_vary():
+    """build_pass_feed must engage occurrence trimming whenever avg_len <
+    capacity (sorted_spmm.trimmed_dims): a regression to untrimmed plans
+    silently re-adds ~1.5x kernel + push-crossing work at bench geometry."""
+    from paddlebox_tpu.ops import sorted_spmm as sp
+    from paddlebox_tpu.ps import mxu_path
+    rng = np.random.default_rng(9)
+    # big enough that the 1/8th-width trim buckets resolve below full
+    # (tiny geometries round back up to untrimmed — also asserted here)
+    ds, eng, tr = _build([_make_block(rng, 2048)], "mxu", batch_size=2048)
+    feed = tr.build_pass_feed(ds)
+    n, s, l, b = feed.data["indices"].shape
+    dims = mxu_path.make_dims(s * l * b, eng.ws["show"].shape[0])
+    n_chunks_eff = feed.plans["rows2d"].shape[1]
+    assert n_chunks_eff < dims.n_chunks, (n_chunks_eff, dims.n_chunks)
+    # and every real occurrence survives the trim
+    per_batch = np.asarray(feed.data["lengths"]).sum(axis=(1, 2))
+    assert n_chunks_eff * dims.chunk >= per_batch.max()
+
+
 def test_spmm_worklist_bound_driver_geometry():
     """n_work is the static worklist bound: n_chunks + n_tiles, independent
     of the key distribution.  At the driver geometry it must stay ~3.5k —

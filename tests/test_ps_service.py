@@ -168,3 +168,73 @@ def test_loopback_throughput_floor():
         assert best > 100, f"loopback PS throughput {best:.0f} MB/s"
     finally:
         srv.shutdown()
+
+
+def test_allreduce_sums_across_world(server):
+    """Keyed array allreduce: every participant receives the identical sum
+    (the exact-global-metrics primitive, ≙ fleet.metrics gloo all_reduce);
+    keys drain after all readers and are reusable."""
+    world = 3
+    results = [None] * world
+    errors = []
+
+    def worker(r):
+        try:
+            c = PSClient(server.addr)
+            arrs = {"pos": np.full((8,), float(r + 1), np.float64),
+                    "scalars": np.arange(5, dtype=np.float64) * (r + 1)}
+            results[r] = c.allreduce(arrs, world, key="m-0")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errors, errors
+    for r in range(world):
+        np.testing.assert_allclose(results[r]["pos"], np.full((8,), 6.0))
+        np.testing.assert_allclose(results[r]["scalars"],
+                                   np.arange(5, dtype=np.float64) * 6)
+
+    # key fully drained -> immediately reusable
+    c = PSClient(server.addr)
+    out = c.allreduce({"x": np.ones(2)}, 1, key="m-0")
+    np.testing.assert_allclose(out["x"], [1, 1])
+
+
+def test_allreduce_matches_global_auc(server):
+    """allreduce_auc_state: two workers' summed buckets give exactly the
+    AUC of the union of their data."""
+    from paddlebox_tpu.metrics.auc import (AucCalculator, accumulate_auc,
+                                           allreduce_auc_state,
+                                           make_auc_state)
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    preds = rng.random((2, 64)).astype(np.float32)
+    labels = (rng.random((2, 64)) < preds).astype(np.float32)  # learnable
+
+    states = [accumulate_auc(make_auc_state(1000), jnp.asarray(preds[r]),
+                             jnp.asarray(labels[r])) for r in range(2)]
+    got = [None, None]
+
+    def worker(r):
+        c = PSClient(server.addr)
+        g = allreduce_auc_state(states[r], c, 2, key="auc-t")
+        calc = AucCalculator(1000)
+        calc.merge_device_state(g)
+        got[r] = calc.compute()["auc"]
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+
+    ref = AucCalculator(1000)
+    ref.add_data(preds.ravel(), labels.ravel())
+    want = ref.compute()["auc"]
+    assert got[0] == got[1]
+    np.testing.assert_allclose(got[0], want, atol=1e-9)

@@ -106,5 +106,12 @@ def test_two_workers_match_single_worker(tmp_path):
     solo_auc = solo[0][-1]["auc"]
     assert duo_auc > 0.55 and abs(duo_auc - solo_auc) < 0.08
 
+    # EXACT global metrics (allreduced bucket tables through the PS,
+    # ≙ fleet.metrics.auc): both ranks must report the IDENTICAL value
+    # every pass — an averaged local AUC cannot guarantee that
+    for p in range(len(duo[0])):
+        assert duo[0][p]["gauc"] == duo[1][p]["gauc"], p
+    assert duo[0][-1]["gauc"] > 0.55
+
     # the PS table holds the merged state from both workers
     assert table.size() > 0
