@@ -75,10 +75,15 @@ def push_rows_sharded(table_local: jnp.ndarray, idx_local: jnp.ndarray,
 # geometry for free (gathers read zeros, scatters write a discarded tile).
 # ---------------------------------------------------------------------------
 
-def _local_plan(idx_local: jnp.ndarray, rows_loc: int, axis: str):
+def local_plan(idx_local: jnp.ndarray, rows_loc: int, axis: str):
     """all_gather the ids and localize to this device's row block: ids
     outside [me*rows_loc, (me+1)*rows_loc) park at the sentinel tile, so
-    ownership masking falls out of the kernel geometry."""
+    ownership masking falls out of the kernel geometry.
+
+    Pull and push need the IDENTICAL plan, so callers should build it once
+    per step (or once per pass) and hand it to both — the sort is the only
+    data-dependent cost in the exchange (≙ the reference building its
+    shard index once in split_input_to_shard, heter_comm_inl.h:1117)."""
     from paddlebox_tpu.ops import sorted_spmm as sp
     me = lax.axis_index(axis)
     idx_all = lax.all_gather(idx_local, axis, axis=0, tiled=True)   # [P]
@@ -89,18 +94,38 @@ def _local_plan(idx_local: jnp.ndarray, rows_loc: int, axis: str):
     return dims, sp.build_plan(local, dims)
 
 
+def _plan_dims(plan, rows_loc: int):
+    """Static geometry a local plan was built with (inv_perm carries the
+    gathered occurrence count).  Sharded exchanges take UNTRIMMED plans
+    only — a trimmed plan keeps inv_perm full-length while the worklists
+    shrink, which would reconstruct an over-sized grid here."""
+    from paddlebox_tpu.ops import sorted_spmm as sp
+    dims = sp.spmm_dims(plan[2].shape[0], rows_loc)
+    if plan[0].shape[0] != dims.n_chunks:
+        raise ValueError(
+            f"sharded exchange needs an untrimmed local_plan: rows2d has "
+            f"{plan[0].shape[0]} chunks, geometry expects {dims.n_chunks}")
+    return dims
+
+
 def pull_rows_sharded_mxu(table_fm_local: jnp.ndarray,
                           idx_local: jnp.ndarray, axis: str,
-                          interpret: bool = False) -> jnp.ndarray:
+                          interpret: bool = False,
+                          plan=None) -> jnp.ndarray:
     """Inside shard_map.  table_fm_local: [W, rows_loc] feature-major block;
     idx_local: [P_loc] global row ids.  → [W, P_loc] pulled values.
 
     ≙ HeterComm pull_merge_sparse (heter_comm_inl.h:1296) with the shard
     walk replaced by all_gather(ids) + local SpMM + psum_scatter(values).
+    plan: precomputed `local_plan` output for these ids (skips the in-step
+    all_gather + sort; pull/push share one plan).
     """
     from paddlebox_tpu.ops import sorted_spmm as sp
     rows_loc = table_fm_local.shape[1]
-    dims, plan = _local_plan(idx_local, rows_loc, axis)
+    if plan is None:
+        dims, plan = local_plan(idx_local, rows_loc, axis)
+    else:
+        dims = _plan_dims(plan, rows_loc)
     rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
     # pad the local block to kernel geometry (sentinel tile = zeros)
     tab = jnp.zeros((table_fm_local.shape[0], dims.n_kernel),
@@ -116,7 +141,8 @@ def pull_rows_sharded_mxu(table_fm_local: jnp.ndarray,
 def push_rows_sharded_mxu(idx_local: jnp.ndarray,
                           payload_local: jnp.ndarray, rows_loc: int,
                           axis: str, interpret: bool = False,
-                          first_only_col: int = -1) -> jnp.ndarray:
+                          first_only_col: int = -1,
+                          plan=None) -> jnp.ndarray:
     """Inside shard_map.  payload_local: [W, P_loc] per-occurrence push
     values.  → merged per-row accumulators [W, rows_loc] for this device's
     block (feed to the local optimizer, ≙ gather_one_node_grad + local
@@ -125,9 +151,13 @@ def push_rows_sharded_mxu(idx_local: jnp.ndarray,
     first_only_col >= 0: that payload row keeps only each table row's FIRST
     occurrence before the merge (exact carry of e.g. the slot id instead of
     a sum — each row is owned by exactly one device, so its first gathered
-    occurrence is the global first)."""
+    occurrence is the global first).
+    plan: precomputed `local_plan` output (shared with the pull)."""
     from paddlebox_tpu.ops import sorted_spmm as sp
-    dims, plan = _local_plan(idx_local, rows_loc, axis)
+    if plan is None:
+        dims, plan = local_plan(idx_local, rows_loc, axis)
+    else:
+        dims = _plan_dims(plan, rows_loc)
     rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
     pay_all = lax.all_gather(payload_local, axis, axis=1, tiled=True)
     srt = jnp.take(pay_all, perm, axis=1)
@@ -145,7 +175,8 @@ def push_rows_sharded_mxu_multinode(idx_local: jnp.ndarray,
                                     payload_local: jnp.ndarray,
                                     rows_loc: int, ici_axis, dcn_axis,
                                     interpret: bool = False,
-                                    first_only_col: int = -1) -> jnp.ndarray:
+                                    first_only_col: int = -1,
+                                    plan=None) -> jnp.ndarray:
     """Two-tier push for the reference's multi-node layout: the table is
     sharded WITHIN a node (ici axis) and REPLICATED across nodes (dcn
     axis), nodes are data-parallel over the batch.
@@ -163,7 +194,8 @@ def push_rows_sharded_mxu_multinode(idx_local: jnp.ndarray,
     sum would add them)."""
     delta_node = push_rows_sharded_mxu(idx_local, payload_local, rows_loc,
                                        ici_axis, interpret=interpret,
-                                       first_only_col=first_only_col)
+                                       first_only_col=first_only_col,
+                                       plan=plan)
     if first_only_col >= 0:
         slots = lax.pmax(delta_node[first_only_col], dcn_axis)
         delta = lax.psum(delta_node.at[first_only_col].set(0.0), dcn_axis)

@@ -387,6 +387,13 @@ class SparseTrainer:
             tbl_spec1 = P(tbl_axes)
             tbl_spec2 = P(tbl_axes, None)
 
+            # pull and push need the IDENTICAL sorted-SpMM plan; build it
+            # ONCE per step in its own shard_map (each device's plan rides
+            # a leading dim split over every device) instead of sorting
+            # twice (≙ split_input_to_shard building the shard index once,
+            # heter_comm_inl.h:1117)
+            plan_specs = (P(batch_axes, None, None),) + (P(batch_axes),) * 7
+
             def core(ws, params, opt_state, auc_state, idx_slb, lengths,
                      dense, labels, valid, plan, extras=None):
                 s, l, b = idx_slb.shape
@@ -396,7 +403,19 @@ class SparseTrainer:
                 idx_slb = jnp.where(jnp.arange(l)[None, :, None]
                                     < lengths[:, None, :], idx_slb, 0)
 
-                def pull_local(show, click, embed_w, mf, mf_size, idx_loc):
+                def plan_local(idx_loc):
+                    _, pl = se.local_plan(idx_loc.reshape(-1), rows_loc,
+                                          tbl_axes)
+                    return pl
+
+                splan = jax.shard_map(
+                    plan_local, mesh=mesh,
+                    in_specs=(P(None, None, batch_axes),),
+                    out_specs=plan_specs,
+                    check_vma=False)(idx_slb)
+
+                def pull_local(show, click, embed_w, mf, mf_size, idx_loc,
+                               *pl):
                     tab = jnp.concatenate(
                         [show[None], click[None], embed_w[None], mf.T,
                          mf_size.astype(jnp.float32)[None]], axis=0)
@@ -404,18 +423,19 @@ class SparseTrainer:
                     # shard — ids/values travel over ICI only
                     vals = se.pull_rows_sharded_mxu(
                         tab, idx_loc.reshape(-1), tbl_axes,
-                        interpret=interpret)
+                        interpret=interpret, plan=pl)
                     b_loc = idx_loc.shape[2]
                     return vals.T.reshape(s, l, b_loc, 3 + d + 1)
 
                 v = jax.shard_map(
                     pull_local, mesh=mesh,
                     in_specs=(tbl_spec1, tbl_spec1, tbl_spec1, tbl_spec2,
-                              tbl_spec1, P(None, None, batch_axes)),
+                              tbl_spec1, P(None, None, batch_axes))
+                    + plan_specs,
                     out_specs=P(None, None, batch_axes, None),
                     check_vma=False)(
                     ws["show"], ws["click"], ws["embed_w"], ws["mf"],
-                    ws["mf_size"], idx_slb)
+                    ws["mf_size"], idx_slb, *splan)
                 pooled = jax.lax.stop_gradient(
                     mxu_path.pool_cvm_values(v, use_cvm))
                 (params, opt_state, auc_state, loss, preds, d_pooled,
@@ -425,24 +445,24 @@ class SparseTrainer:
                 payload = mxu_path.push_payload(d_pooled, ins_cvm, slot_ids,
                                                 (s, l, b))   # [S,L,B,D+4]
 
-                def push_local(idx_loc, pay_loc):
+                def push_local(idx_loc, pay_loc, *pl):
                     p_loc = idx_loc.size
                     pay_fm = pay_loc.reshape(p_loc, d + 4).T  # [D+4, P_loc]
                     if multinode:
                         return se.push_rows_sharded_mxu_multinode(
                             idx_loc.reshape(-1), pay_fm, rows_loc,
                             tbl_axes, "dp", interpret=interpret,
-                            first_only_col=d + 3)
+                            first_only_col=d + 3, plan=pl)
                     return se.push_rows_sharded_mxu(
                         idx_loc.reshape(-1), pay_fm, rows_loc, tbl_axes,
-                        interpret=interpret, first_only_col=d + 3)
+                        interpret=interpret, first_only_col=d + 3, plan=pl)
 
                 delta = jax.shard_map(
                     push_local, mesh=mesh,
                     in_specs=(P(None, None, batch_axes),
-                              P(None, None, batch_axes, None)),
+                              P(None, None, batch_axes, None)) + plan_specs,
                     out_specs=P(None, tbl_axes),
-                    check_vma=False)(idx_slb, payload)        # [D+4, n_rows]
+                    check_vma=False)(idx_slb, payload, *splan)  # [D+4, n_rows]
                 acc = mxu_path.acc_from_delta(delta, n_rows)
                 ws = sparse_opt.apply_push(ws, acc, sgd_cfg)
                 out = (ws, params, opt_state, auc_state, loss, preds)
