@@ -78,8 +78,15 @@ def _measure(take_rows: int, sort_n: int, w: int, backend: str) -> str:
         rng.integers(0, sort_n, take_rows).astype(np.int32))
     dest = jnp.asarray(rng.permutation(sort_n).astype(np.int32))
     t_take = _bench_once(lambda v, i: jnp.take(v, i, axis=0), (src, idx))
-    t_sort = _bench_once(
-        lambda v, d: permute_by_dest(tuple(v.T), d), (src, dest))
+    try:
+        t_sort = _bench_once(
+            lambda v, d: permute_by_dest(tuple(v.T), d), (src, dest))
+    except Exception as e:  # noqa: BLE001 — a lowering failure on an
+        # unusual backend must degrade to the safe default, not kill the
+        # step build (the sort mode is a pure optimization)
+        log.warning("crossing auto-tune: sort lowering failed (%s: %s) — "
+                    "using take", type(e).__name__, e)
+        return "take"
     mode = "sort" if t_sort < t_take else "take"
     log.info("crossing auto-tune (take_rows=%d sort_n=%d w=%d %s): "
              "take=%.2fms sort=%.2fms -> %s", take_rows, sort_n, w, backend,

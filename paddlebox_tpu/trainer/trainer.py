@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import threading
 import time
-from functools import partial
+from functools import lru_cache, partial
 from typing import Dict, Iterator, Optional
 
 import numpy as np
@@ -373,17 +373,13 @@ class SparseTrainer:
             interpret = jax.default_backend() == "cpu"
             half = self._pooled_dense_half()
             mesh = self.topology.mesh
-            batch_axes = ("dp", "sharding")
             # multi-node layout when both axes are real: table sharded over
             # `sharding` (intra-node/ICI), replicated over `dp` (node/DCN),
             # push merges per node then psums across nodes
             # (≙ gather_one_node_grad + gather_multi_node_grad,
             # heter_comm_inl.h:2027,2131); otherwise one flat pool
-            multinode = self.topology.multinode_table()
-            tbl_axes = ("sharding",) if multinode else batch_axes
-            n_tbl = 1
-            for a in tbl_axes:
-                n_tbl *= self.topology.axis_size(a)
+            batch_axes, tbl_axes, n_tbl, _, multinode = \
+                self._sharded_layout()
             tbl_spec1 = P(tbl_axes)
             tbl_spec2 = P(tbl_axes, None)
 
@@ -403,16 +399,20 @@ class SparseTrainer:
                 idx_slb = jnp.where(jnp.arange(l)[None, :, None]
                                     < lengths[:, None, :], idx_slb, 0)
 
-                def plan_local(idx_loc):
-                    _, pl = se.local_plan(idx_loc.reshape(-1), rows_loc,
-                                          tbl_axes)
-                    return pl
+                if plan is not None:
+                    # pass-resident per-device plans (build_pass_feed)
+                    splan = plan
+                else:
+                    def plan_local(idx_loc):
+                        _, pl = se.local_plan(idx_loc.reshape(-1), rows_loc,
+                                              tbl_axes)
+                        return pl
 
-                splan = jax.shard_map(
-                    plan_local, mesh=mesh,
-                    in_specs=(P(None, None, batch_axes),),
-                    out_specs=plan_specs,
-                    check_vma=False)(idx_slb)
+                    splan = jax.shard_map(
+                        plan_local, mesh=mesh,
+                        in_specs=(P(None, None, batch_axes),),
+                        out_specs=plan_specs,
+                        check_vma=False)(idx_slb)
 
                 def pull_local(show, click, embed_w, mf, mf_size, idx_loc,
                                *pl):
@@ -592,7 +592,8 @@ class SparseTrainer:
             if arrays.rank_offset is not None:
                 shardings["rank_offset"] = t.sharding(None, dp, None)
         feed = pf.upload_pass(arrays, keep_host=keep, sharding=shardings)
-        if self._resolve_path() == "mxu":
+        path = self._resolve_path()
+        if path == "mxu":
             from paddlebox_tpu.ops import sorted_spmm as sp
             from paddlebox_tpu.ps import mxu_path
             n, s, l, b = feed.data["indices"].shape
@@ -604,7 +605,58 @@ class SparseTrainer:
             per_batch = arrays.lengths.reshape(s, n, b).sum(axis=(0, 2))
             eff = sp.trimmed_dims(dims, int(per_batch.max()))
             pf.precompute_plans(feed, dims, eff)
+        elif path == "mxu_sharded":
+            self._precompute_sharded_plans(feed)
         return feed
+
+    def _sharded_layout(self):
+        """(batch_axes, tbl_axes, n_tbl, rows_loc, multinode) of the
+        mxu_sharded exchange — single source for the core, the pass-plan
+        builder and the stale-plan check."""
+        batch_axes = ("dp", "sharding")
+        multinode = self.topology.multinode_table()
+        tbl_axes = ("sharding",) if multinode else batch_axes
+        n_tbl = 1
+        for a in tbl_axes:
+            n_tbl *= self.topology.axis_size(a)
+        n_rows = self.engine.ws["show"].shape[0]
+        return batch_axes, tbl_axes, n_tbl, n_rows // n_tbl, multinode
+
+    def _precompute_sharded_plans(self, feed: PackedPassFeed) -> None:
+        """Pass-resident per-device exchange plans: each device's localized
+        sorted-SpMM plan for every batch, built once at pass build (the
+        multi-chip twin of precompute_plans — the hot step then contains
+        no sorts on ANY path; ≙ the pass-scope shard index of
+        split_input_to_shard, heter_comm_inl.h:1117).
+
+        Footprint: plans are UNTRIMMED (sharded exchanges localize ids
+        per device, so padding does not sort to a droppable prefix) and
+        scale as n_batches x n_devices x gathered-P — the byte count is
+        logged; chunked residency is the escape hatch if a pass outgrows
+        HBM (split the pass into several feeds)."""
+        batch_axes, tbl_axes, n_tbl, rows_loc, _ = self._sharded_layout()
+        build = _sharded_plan_builder(self.topology.mesh, batch_axes,
+                                      tbl_axes, rows_loc)
+        pl = build(feed.data["indices"])
+        feed.plans = {"rows2d": pl[0], "perm": pl[1], "inv_perm": pl[2],
+                      "ch": pl[3], "tl": pl[4], "fg": pl[5], "fs": pl[6],
+                      "first_occ": pl[7]}
+        feed.plan_dims = self._sharded_plan_key(feed)
+        import logging
+        logging.getLogger(__name__).info(
+            "sharded pass plans resident: %.0f MB global "
+            "(n_batches x n_devices x gathered-P, untrimmed)",
+            sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                for a in feed.plans.values()) / 1e6)
+
+    def _sharded_plan_key(self, feed: PackedPassFeed):
+        """Identity of the exchange geometry sharded plans were built
+        for (feed shape, table height, tbl axes layout) — any change makes
+        resident plans silently corrupting, so the packed loop compares
+        this before every pass."""
+        _, tbl_axes, n_tbl, _, _ = self._sharded_layout()
+        return ("mxu_sharded", tuple(feed.data["indices"].shape),
+                self.engine.ws["show"].shape[0], tbl_axes, n_tbl)
 
     def _require_pv_for_rank(self, dataset) -> None:
         """rank_offset is only meaningful when every batch holds WHOLE page
@@ -682,6 +734,14 @@ class SparseTrainer:
                     f"{feed.plan_dims}, but the working set now needs "
                     f"{cur} — rebuild the feed (build_pass_feed) after a "
                     "table resize")
+        elif feed.plans is not None and path == "mxu_sharded":
+            cur = self._sharded_plan_key(feed)
+            if cur != feed.plan_dims:
+                raise ValueError(
+                    "PackedPassFeed sharded plans were built for "
+                    f"{feed.plan_dims}, but the exchange now needs {cur} — "
+                    "rebuild the feed (build_pass_feed) after a table or "
+                    "mesh change")
         if self._packed_step_fn is None \
                 or self._packed_sig != self._packed_signature(feed):
             self._build_packed_step(feed)
@@ -914,3 +974,28 @@ class SparseTrainer:
     def reset_metrics(self):
         self.auc_state = make_auc_state(self.auc_table_size)
         self.auc.reset()
+
+
+@lru_cache(maxsize=None)
+def _sharded_plan_builder(mesh, batch_axes, tbl_axes, rows_loc: int):
+    """Cached jitted pass-plan builder (one trace per exchange geometry —
+    a fresh jit per pass would re-trace the shard_map'd sort pipeline at
+    every pass build)."""
+    from jax.sharding import PartitionSpec as P
+    from paddlebox_tpu.ps import sharded_embedding as se
+    plan_specs = (P(batch_axes, None, None),) + (P(batch_axes),) * 7
+
+    @jax.jit
+    def build(idx_all):
+        def one(idx_slb):
+            def plan_local(idx_loc):
+                _, pl = se.local_plan(idx_loc.reshape(-1), rows_loc,
+                                      tbl_axes)
+                return pl
+            return jax.shard_map(
+                plan_local, mesh=mesh,
+                in_specs=(P(None, None, batch_axes),),
+                out_specs=plan_specs, check_vma=False)(idx_slb)
+        return jax.lax.map(one, idx_all)
+
+    return build
