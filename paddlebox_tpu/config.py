@@ -24,7 +24,12 @@ class SlotConfig:
 
     name: str
     slot_id: int = 0
-    dtype: str = "uint64"  # "uint64" (sparse feasigns) or "float" (dense)
+    # "uint64" (sparse feasigns), "float" (dense), or "string" (aux keys
+    # resolved through an InputTable into stable int indices at parse
+    # time — ≙ InputTableDataFeed, data_feed.h:2224; the index plane
+    # reaches the model as an extras input, gathered against a
+    # ReplicaCache/dense var like ops lookup_input)
+    dtype: str = "uint64"
     is_dense: bool = False
     dim: int = 1           # values per instance for dense slots
     capacity: int = 1      # max feasigns per instance for sparse slots
@@ -47,14 +52,34 @@ class DataFeedConfig:
 
     def __post_init__(self):
         object.__setattr__(self, "slots", tuple(self.slots))
+        dense_str = [s.name for s in self.slots
+                     if s.dtype == "string" and s.is_dense]
+        if dense_str:
+            raise ValueError(
+                f"string slots {dense_str} cannot be is_dense — they are "
+                "aux index planes (InputTable), not dense features")
+        reserved = {"indices", "lengths", "dense", "labels", "valid",
+                    "rank_offset"}
+        bad = [s.name for s in self.string_slots if s.name in reserved]
+        if bad:
+            raise ValueError(
+                f"string slot names {bad} collide with reserved feed plane "
+                "names — rename the slot")
 
     @property
     def sparse_slots(self) -> List[SlotConfig]:
-        return [s for s in self.slots if not s.is_dense]
+        return [s for s in self.slots
+                if not s.is_dense and s.dtype != "string"]
 
     @property
     def dense_slots(self) -> List[SlotConfig]:
         return [s for s in self.slots if s.is_dense]
+
+    @property
+    def string_slots(self) -> List[SlotConfig]:
+        """Aux string-keyed slots (InputTable-resolved index planes)."""
+        return [s for s in self.slots
+                if s.dtype == "string" and not s.is_dense]
 
 
 @dataclasses.dataclass(frozen=True)

@@ -37,6 +37,8 @@ class PackedBatch:
     keys: Optional[np.ndarray] = None   # [S, B, L] uint64 raw feasigns
     ins_ids: Optional[list] = None      # [num_real] instance ids (for dump)
     rank_offset: Optional[np.ndarray] = None  # [B, 1+2*max_rank] int32 (pv)
+    # InputTable-resolved aux index planes [B, cap] int32 per string slot
+    aux: Optional[dict] = None
 
 
 class BatchPacker:
@@ -128,6 +130,19 @@ class BatchPacker:
                                          block.rank, B,
                                          self.config.max_rank)
 
+        aux = None
+        if self.config.string_slots:
+            # InputTable index planes (≙ InputTableDataFeed feed vars,
+            # data_feed.h:2224) — int32 indices, 0 = miss/pad row
+            aux = {}
+            for slot in self.config.string_slots:
+                vals, offs = block.aux_slots[slot.name]
+                plane = np.zeros((B, slot.capacity), np.int32)
+                padded, _ = self._pad_ragged(vals, offs, slot.capacity)
+                plane[:n] = padded.astype(np.int32)
+                aux[slot.name] = plane
+
         return PackedBatch(indices=indices, lengths=lengths, dense=dense,
                            labels=labels, valid=valid, num_real=n, keys=keys,
-                           ins_ids=block.ins_ids, rank_offset=rank_off)
+                           ins_ids=block.ins_ids, rank_offset=rank_off,
+                           aux=aux)

@@ -39,19 +39,34 @@ def parse_logkey(log_key: str) -> Tuple[int, int, int]:
 
 
 class SlotParser:
-    """Parses MultiSlot text lines into SlotRecordBlocks (python fallback)."""
+    """Parses MultiSlot text lines into SlotRecordBlocks (python fallback).
+
+    input_table: ps.aux_tables.InputTable shared by every parser of a
+    dataset — "string"-dtype slots resolve each token through it into a
+    stable int index at parse time (≙ InputTableDataFeed,
+    data_feed.h:2224), stored in block.aux_slots as INDICES (0 = miss
+    row, the ReplicaCache convention) so they never enter all_keys()."""
 
     def __init__(self, config: DataFeedConfig,
-                 parse_ins_id: bool = False, parse_logkey: bool = False):
+                 parse_ins_id: bool = False, parse_logkey: bool = False,
+                 input_table=None):
         self.config = config
         self.parse_ins_id = parse_ins_id
         self.parse_logkey = parse_logkey
+        self.input_table = input_table
+        if config.string_slots and input_table is None:
+            raise ValueError(
+                "feed config declares string slots "
+                f"{[s.name for s in config.string_slots]} but no "
+                "InputTable was provided to resolve them")
 
     def parse_block(self, lines: Sequence[str]) -> SlotRecordBlock:
         cfg = self.config
         n = len(lines)
         u_vals: dict = {s.name: [] for s in cfg.slots if s.dtype == "uint64"}
         u_lens: dict = {k: np.zeros((n,), np.int64) for k in u_vals}
+        a_vals: dict = {s.name: [] for s in cfg.slots if s.dtype == "string"}
+        a_lens: dict = {k: np.zeros((n,), np.int64) for k in a_vals}
         f_vals: dict = {s.name: [] for s in cfg.slots if s.dtype == "float"}
         f_lens: dict = {k: np.zeros((n,), np.int64) for k in f_vals}
         ins_ids: List[str] = [] if self.parse_ins_id or self.parse_logkey else None
@@ -81,6 +96,10 @@ class SlotParser:
                     u_vals[slot.name].append(
                         np.array([int(v) for v in vals], dtype=np.uint64))
                     u_lens[slot.name][li] = num
+                elif slot.dtype == "string":
+                    a_vals[slot.name].append(
+                        self.input_table.get_or_insert_many(vals))
+                    a_lens[slot.name][li] = num
                 else:
                     f_vals[slot.name].append(
                         np.array(vals, dtype=np.float32))
@@ -99,6 +118,12 @@ class SlotParser:
             np.cumsum(f_lens[k], out=off[1:])
             block.float_slots[k] = (
                 np.concatenate(parts) if parts else np.empty((0,), np.float32),
+                off)
+        for k, parts in a_vals.items():
+            off = np.zeros((n + 1,), np.int64)
+            np.cumsum(a_lens[k], out=off[1:])
+            block.aux_slots[k] = (
+                np.concatenate(parts) if parts else np.empty((0,), np.uint64),
                 off)
         stat_add("stat_total_feasign_num_in_mem", block.feasign_count)
         return block
@@ -136,11 +161,12 @@ class DataFeed:
 
     def __init__(self, config: DataFeedConfig, parse_ins_id: bool = False,
                  parse_logkey: bool = False, chunk_lines: int = 4096,
-                 use_native: bool = True):
+                 use_native: bool = True, input_table=None):
         self.config = config
         self.chunk_lines = chunk_lines
         self._parser = make_parser(config, parse_ins_id, parse_logkey,
-                                   use_native=use_native)
+                                   use_native=use_native,
+                                   input_table=input_table)
 
     def read_file(self, path: str) -> Iterator[SlotRecordBlock]:
         with open_file(path, self.config.pipe_command) as f:
@@ -158,9 +184,12 @@ class DataFeed:
 
 
 def make_parser(config: DataFeedConfig, parse_ins_id: bool = False,
-                parse_logkey_: bool = False, use_native: bool = True):
-    """Return the native C++ parser when built, else the python fallback."""
-    if use_native:
+                parse_logkey_: bool = False, use_native: bool = True,
+                input_table=None):
+    """Return the native C++ parser when built, else the python fallback.
+    String (InputTable) slots force the python parser — the table's
+    string→index map lives in the python process."""
+    if use_native and not config.string_slots:
         try:
             from paddlebox_tpu.native import slot_parser as native_parser
             if native_parser.available():
@@ -168,7 +197,8 @@ def make_parser(config: DataFeedConfig, parse_ins_id: bool = False,
                     config, parse_ins_id, parse_logkey_)
         except Exception:
             pass
-    return SlotParser(config, parse_ins_id, parse_logkey_)
+    return SlotParser(config, parse_ins_id, parse_logkey_,
+                      input_table=input_table)
 
 
 class ParserPluginManager:

@@ -95,10 +95,18 @@ class SlotDataset:
     def __init__(self, feed_config: DataFeedConfig,
                  parse_ins_id: bool = False, parse_logkey: bool = False,
                  read_threads: int = 4,
-                 transport: Optional[ShuffleTransport] = None):
+                 transport: Optional[ShuffleTransport] = None,
+                 input_table=None):
         self.feed_config = feed_config
         self.parse_ins_id = parse_ins_id
         self.parse_logkey = parse_logkey
+        # aux string-key table shared by every reader thread (string-dtype
+        # slots resolve through it at parse time — ≙ InputTableDataFeed,
+        # data_feed.h:2224); auto-created when the config declares any
+        self.input_table = input_table
+        if feed_config.string_slots and input_table is None:
+            from paddlebox_tpu.ps.aux_tables import InputTable
+            self.input_table = InputTable()
         self.read_threads = read_threads
         self.transport = transport or LoopbackTransport()
         self.filelist: List[str] = []
@@ -124,7 +132,8 @@ class SlotDataset:
 
         def read_one(path: str) -> None:
             feed = DataFeed(self.feed_config, self.parse_ins_id,
-                            self.parse_logkey)
+                            self.parse_logkey,
+                            input_table=self.input_table)
             for block in feed.read_file(path):
                 for consumer in self._key_consumers:
                     consumer(block.all_keys())
@@ -172,6 +181,16 @@ class SlotDataset:
         world = self.transport.world_size
         if world <= 1:
             return self.local_shuffle()
+        if self.feed_config.string_slots:
+            # aux indices are minted by THIS process's InputTable — another
+            # node's table assigns different indices to the same strings,
+            # so shuffled planes would gather wrong replica-cache rows.
+            # (The reference resolves at feed time, after its shuffle;
+            # resolve-late is the multi-host escape hatch.)
+            raise ValueError(
+                "global_shuffle with string (InputTable) slots is not "
+                "supported: indices are process-local — shard files per "
+                "worker instead, or shuffle the raw text upstream")
         merged = SlotRecordBlock.concat(self._blocks)
         if merged.n:
             if by_ins_id and merged.ins_ids is not None:

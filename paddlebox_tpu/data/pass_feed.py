@@ -57,6 +57,18 @@ class HostPassArrays:
     batch_real: Optional[np.ndarray] = None   # [N] int64
     batch_base: Optional[np.ndarray] = None   # [N] int64
     rank_offset: Optional[np.ndarray] = None  # [N*B, 1+2*max_rank] int32
+    # InputTable-resolved aux index planes {name: [N*B, cap] int32}
+    aux: Optional[Dict[str, np.ndarray]] = None
+
+    def extra_planes(self) -> Dict[str, np.ndarray]:
+        """Every optional per-record plane (rank_offset + aux index
+        planes) — single source for upload/relayout/sharding plumbing."""
+        out = {}
+        if self.rank_offset is not None:
+            out["rank_offset"] = self.rank_offset
+        if self.aux:
+            out.update(self.aux)
+        return out
 
     def real_range(self, i: int):
         """(plane_row_lo, real_count, real_order_base) of batch i."""
@@ -165,11 +177,22 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
     valid = np.zeros((nb,), dtype=bool)
     valid[pos] = True
 
+    aux = None
+    if feed_config.string_slots:
+        # InputTable index planes (≙ InputTableDataFeed, data_feed.h:2224)
+        aux = {}
+        for slot in feed_config.string_slots:
+            vals, offs = merged.aux_slots[slot.name]
+            padded, _ = packer._pad_ragged(vals, offs, slot.capacity)
+            plane = np.zeros((nb, slot.capacity), np.int32)
+            plane[pos] = padded.astype(np.int32)
+            aux[slot.name] = plane
+
     out = HostPassArrays(indices=indices, lengths=lengths, dense=dense,
                          labels=labels, valid=valid, n_batches=n_batches,
                          batch_size=batch_size, num_real=n,
                          ins_ids=merged.ins_ids, batch_real=batch_real,
-                         batch_base=batch_base)
+                         batch_base=batch_base, aux=aux)
     if feed_config.rank_offset:
         # ≙ GetRankOffset per batch (data_feed.cc:1855) — batch-local row
         # indices; meaningful under pv grouping (whole pvs per batch)
@@ -236,8 +259,9 @@ def _relayout(d, N: int, B: int):
     }
     lbl = d["labels"]
     out["labels"] = lbl.reshape((N, B) + lbl.shape[1:])
-    if "rank_offset" in d:
-        out["rank_offset"] = d["rank_offset"].reshape(N, B, -1)
+    for k in d:   # extra per-record planes ([N*B, w] -> [N, B, w])
+        if k not in out and k != "labels":
+            out[k] = d[k].reshape(N, B, -1)
     return out
 
 
@@ -277,8 +301,9 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
             "dense": NamedSharding(mesh, P(spec)),
             "labels": NamedSharding(mesh, P(spec)),
             "valid": NamedSharding(mesh, P(spec)),
-            "rank_offset": NamedSharding(mesh, P(spec, None)),
         }
+        for k in h.extra_planes():
+            in_shardings[k] = NamedSharding(mesh, P(spec, None))
 
     def put(name, a):
         if name in in_shardings:
@@ -292,8 +317,8 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
         "labels": put("labels", h.labels),
         "valid": put("valid", h.valid),
     }
-    if h.rank_offset is not None:
-        dev["rank_offset"] = put("rank_offset", h.rank_offset)
+    for k, v in h.extra_planes().items():
+        dev[k] = put(k, v)
     data = _relayout(dev, N, B)
     if sharding is not None:
         data = {k: jax.device_put(v, sharding[k]) if k in sharding else v

@@ -40,6 +40,12 @@ def block_to_wire(block: SlotRecordBlock) -> bytes:
     for name, (vals, offs) in block.float_slots.items():
         msg["f"][name] = np.asarray(vals)
         msg["fo"][name] = np.asarray(offs)
+    if block.aux_slots:
+        msg["a"] = {}
+        msg["ao"] = {}
+        for name, (vals, offs) in block.aux_slots.items():
+            msg["a"][name] = np.asarray(vals)
+            msg["ao"][name] = np.asarray(offs)
     if block.ins_ids is not None:
         if any("\x00" in i for i in block.ins_ids):
             raise ValueError("ins_ids may not contain NUL bytes")
@@ -61,6 +67,8 @@ def block_from_wire(payload: bytes) -> SlotRecordBlock:
             blk.uint64_slots[name] = (vals, msg["uo"][name])
         for name, vals in msg.get("f", {}).items():
             blk.float_slots[name] = (vals, msg["fo"][name])
+        for name, vals in msg.get("a", {}).items():
+            blk.aux_slots[name] = (vals, msg["ao"][name])
         if "ins_ids" in msg:
             n_ids = int(msg["ins_ids_n"])
             ids = msg["ins_ids"].split("\x00") if n_ids else []

@@ -57,6 +57,9 @@ class SlotRecordBlock:
     n: int
     uint64_slots: Dict[str, Ragged] = dataclasses.field(default_factory=dict)
     float_slots: Dict[str, Ragged] = dataclasses.field(default_factory=dict)
+    # aux index slots (InputTable-resolved string keys) — NOT feasigns:
+    # excluded from all_keys() so they never register in the PS pass build
+    aux_slots: Dict[str, Ragged] = dataclasses.field(default_factory=dict)
     ins_ids: Optional[List[str]] = None
     search_ids: Optional[np.ndarray] = None   # uint64, PV/AucRunner merge key
     cmatch: Optional[np.ndarray] = None       # int32
@@ -74,6 +77,8 @@ class SlotRecordBlock:
                             for k, v in self.uint64_slots.items()}
         out.float_slots = {k: _select_ragged(v, idx)
                            for k, v in self.float_slots.items()}
+        out.aux_slots = {k: _select_ragged(v, idx)
+                         for k, v in self.aux_slots.items()}
         if self.ins_ids is not None:
             out.ins_ids = [self.ins_ids[i] for i in idx]
         for f in ("search_ids", "cmatch", "rank"):
@@ -102,6 +107,9 @@ class SlotRecordBlock:
         out.float_slots = {
             k: _concat_ragged([b.float_slots[k] for b in blocks], np.float32)
             for k in f_keys}
+        out.aux_slots = {
+            k: _concat_ragged([b.aux_slots[k] for b in blocks], np.uint64)
+            for k in blocks[0].aux_slots.keys()}
         if blocks[0].ins_ids is not None:
             out.ins_ids = [i for b in blocks for i in (b.ins_ids or [])]
         for f in ("search_ids", "cmatch", "rank"):

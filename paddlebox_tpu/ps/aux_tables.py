@@ -78,6 +78,20 @@ class InputTable:
                 self._map[key] = idx
             return idx
 
+    def get_or_insert_many(self, keys: Sequence[str]) -> np.ndarray:
+        """Batched resolve — one lock round-trip per call, not per token
+        (the parser hot loop resolves a whole slot occurrence list)."""
+        with self._lock:
+            out = np.empty((len(keys),), np.uint64)
+            m = self._map
+            for i, k in enumerate(keys):
+                idx = m.get(k)
+                if idx is None:
+                    idx = len(m) + 1
+                    m[k] = idx
+                out[i] = idx
+            return out
+
     def lookup(self, keys: Sequence[str]) -> np.ndarray:
         with self._lock:
             return np.array([self._map.get(k, 0) for k in keys], np.int32)

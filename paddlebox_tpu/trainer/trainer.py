@@ -82,11 +82,12 @@ class SparseTrainer:
         # rank_offset) must have the feed actually produce them — fail at
         # construction, not with an in-trace TypeError mid-pass
         need = set(getattr(model, "extra_inputs", ()))
-        unknown = need - {"rank_offset"}
+        have = {"rank_offset"} | {s.name for s in feed_config.string_slots}
+        unknown = need - have
         if unknown:
             raise ValueError(
                 f"model.extra_inputs {sorted(unknown)} are not feed planes "
-                "this trainer can supply (supported: rank_offset)")
+                f"this feed supplies (available: {sorted(have)})")
         if "rank_offset" in need:
             if not feed_config.rank_offset:
                 raise ValueError(
@@ -589,8 +590,8 @@ class SparseTrainer:
                            else t.sharding(None, dp, None)),
                 "valid": t.sharding(None, dp),
             }
-            if arrays.rank_offset is not None:
-                shardings["rank_offset"] = t.sharding(None, dp, None)
+            for k in arrays.extra_planes():
+                shardings[k] = t.sharding(None, dp, None)
         feed = pf.upload_pass(arrays, keep_host=keep, sharding=shardings)
         path = self._resolve_path()
         if path == "mxu":
@@ -832,6 +833,8 @@ class SparseTrainer:
         extras = {}
         if batch.rank_offset is not None:
             extras["rank_offset"] = batch.rank_offset
+        if batch.aux:
+            extras.update(batch.aux)
         if self._batch_sharding is None:
             return tuple(jnp.asarray(a) for a in arrs) + (
                 {k: jnp.asarray(v) for k, v in extras.items()},)

