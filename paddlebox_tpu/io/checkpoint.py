@@ -107,7 +107,12 @@ def save_xbox(engine: BoxPSEngine, path: str, base: bool = True) -> int:
                     (np.abs(soa["delta_score"]) >= acc.delta_threshold)
                 idx = np.nonzero(keep)[0]
                 for i in idx:
-                    mf = soa["mf"][i]
+                    # uncreated embedx serves zeros in training
+                    # (pull_sparse masks by mf_size) — dump the SAME
+                    # values or the serving side would see the random
+                    # candidate init (train/serve skew)
+                    mf = (soa["mf"][i] if soa["mf_size"][i] > 0
+                          else np.zeros_like(soa["mf"][i]))
                     if qbits:
                         scale = (1 << (qbits - 1)) - 1
                         mf = np.round(mf * scale) / scale
@@ -117,3 +122,58 @@ def save_xbox(engine: BoxPSEngine, path: str, base: bool = True) -> int:
                             f"{soa['embed_w'][i]:.6g}\t{vals}\n")
                     n += 1
     return n
+
+
+def load_xbox(engine: BoxPSEngine, path: str) -> np.ndarray:
+    """Serving-side read-back of an xbox dump — the loader the reference
+    keeps in its serving stack (the dump of SaveBase/SaveDelta,
+    box_wrapper.cc:1286, is what the online predictor consumes).
+
+    Writes the dumped rows into the engine's host table (optimizer state
+    zero-initialized — serving never pushes) and returns the loaded keys;
+    the caller then runs the normal pass lifecycle over them and
+    optionally `engine.freeze_for_serving()` for int16 embedx pulls:
+
+        keys = load_xbox(engine, path)
+        engine.begin_feed_pass(); engine.add_keys(keys)
+        engine.end_feed_pass(); engine.begin_pass()
+        engine.freeze_for_serving()
+    """
+    d = engine.config.embedding_dim
+    keys, shows, clicks, ws_, mfs = [], [], [], [], []
+    with open(path) as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) != 5:
+                raise ValueError(f"malformed xbox line: {line[:80]!r}")
+            keys.append(int(parts[0]))
+            shows.append(float(parts[1]))
+            clicks.append(float(parts[2]))
+            ws_.append(float(parts[3]))
+            mf = (np.array(parts[4].split(), np.float32)
+                  if parts[4] else np.zeros((0,), np.float32))
+            if len(mf) != d:
+                raise ValueError(
+                    f"xbox row mf width {len(mf)} != table dim {d}")
+            mfs.append(mf)
+    keys = np.asarray(keys, np.uint64)
+    if not len(keys):
+        return keys
+    rows = engine.table.bulk_pull(keys)     # schema defaults
+    rows["show"] = np.asarray(shows, np.float32)
+    rows["click"] = np.asarray(clicks, np.float32)
+    rows["embed_w"] = np.asarray(ws_, np.float32)
+    rows["mf"] = np.stack(mfs)
+    # the dump writes zeros for uncreated embedx (see save_xbox) — derive
+    # mf_size so serving pulls mask exactly like training did
+    created = np.any(rows["mf"] != 0.0, axis=1)
+    rows["mf_size"] = np.where(created, d, 0).astype(rows["mf_size"].dtype)
+    # zero every field the dump does not carry (optimizer state, scores)
+    # — serving never pushes, and a delta-refresh over existing rows must
+    # not resurrect their stale training state
+    keep = {"show", "click", "embed_w", "mf", "mf_size", "slot"}
+    for fld in rows:
+        if fld not in keep:
+            rows[fld] = np.zeros_like(rows[fld])
+    engine.table.bulk_write(keys, rows)
+    return keys

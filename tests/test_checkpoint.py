@@ -51,3 +51,52 @@ def test_xbox_dump(data_file, tmp_path):
     first = lines[0].split("\t")
     assert len(first) == 5  # key, show, click, embed_w, mf values
     assert len(first[4].split()) == engine.config.embedding_dim
+
+
+def test_xbox_serving_roundtrip(data_file, tmp_path):
+    """Dump → load_xbox into a FRESH engine → serve: pulled values must
+    match the trained engine's exactly, and the int16 serving freeze
+    stays within one quantization grid step."""
+    import jax.numpy as jnp
+    from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+    from paddlebox_tpu.io.checkpoint import load_xbox
+    from paddlebox_tpu.ps import embedding
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+
+    engine, trainer, _ = run_training(data_file, CtrDnn, passes=2)
+    path = str(tmp_path / "xbox" / "serve.txt")
+    n = save_xbox(engine, path, base=True)
+    assert n > 0
+
+    srv = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=engine.config.embedding_dim, shard_num=4,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    keys = load_xbox(srv, path)
+    assert len(keys) == n
+    srv.begin_feed_pass()
+    srv.add_keys(keys)
+    srv.end_feed_pass()
+    srv.begin_pass()
+
+    # serve a batch of the dumped keys through the pull path — values
+    # must match the TRAINED host table's rows key-for-key (the trained
+    # engine's device pass is already released; the table is the truth)
+    probe = keys[:: max(1, len(keys) // 64)][:32]
+    rows = engine.table.bulk_pull(probe)
+    # training serves zeros for uncreated embedx (pull_sparse mf_size
+    # mask) — the serving side must reproduce exactly that
+    mf_exp = rows["mf"] * (rows["mf_size"] > 0)[:, None]
+    v_exp = np.concatenate(
+        [rows["show"][:, None], rows["click"][:, None],
+         rows["embed_w"][:, None], mf_exp], axis=1).astype(np.float32)
+    idx_s = jnp.asarray(srv.mapper(probe).reshape(1, -1, 1))
+    v_s = np.asarray(embedding.pull_sparse(srv.ws, idx_s))[0, :, 0]
+    np.testing.assert_allclose(v_s, v_exp, rtol=1e-4, atol=1e-4)
+
+    # int16 freeze: quantized serving pulls within one grid step
+    srv.freeze_for_serving()
+    v_q = np.asarray(embedding.pull_sparse(srv.ws, idx_s))[0, :, 0]
+    np.testing.assert_allclose(v_q[:, :3], v_s[:, :3], atol=1e-5)
+    mf_scale = np.abs(v_s[:, 3:]).max() / 32767.0
+    np.testing.assert_allclose(v_q[:, 3:], v_s[:, 3:],
+                               atol=max(3 * mf_scale, 1e-4))

@@ -159,6 +159,31 @@ def test_feed_plans_are_trimmed_when_lengths_vary():
     assert n_chunks_eff * dims.chunk >= per_batch.max()
 
 
+def test_sort_crossing_trains_identically():
+    """FLAGS_mxu_crossing=sort through the REAL packed train_pass must
+    reproduce the take lowering's loss/AUC exactly (the crossings are
+    pure permutations — any divergence is a plan/crossing bug)."""
+    from paddlebox_tpu import flags
+
+    def run():
+        rng = np.random.default_rng(11)
+        ds, eng, tr = _build([_make_block(rng, 256)], "mxu")
+        feed = tr.build_pass_feed(ds)
+        return tr.train_pass(feed)
+
+    old = flags.get_flags("mxu_crossing")
+    try:
+        flags.set_flags({"mxu_crossing": "take"})
+        a = run()
+        flags.set_flags({"mxu_crossing": "sort"})
+        b = run()
+    finally:
+        flags.set_flags({"mxu_crossing": old})
+    assert a["batches"] == b["batches"]
+    np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(a["auc"], b["auc"], rtol=1e-5, atol=1e-6)
+
+
 def test_spmm_worklist_bound_driver_geometry():
     """n_work is the static worklist bound: n_chunks + n_tiles, independent
     of the key distribution.  At the driver geometry it must stay ~3.5k —
