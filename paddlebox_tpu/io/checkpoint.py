@@ -159,6 +159,17 @@ def load_xbox(engine: BoxPSEngine, path: str) -> np.ndarray:
     keys = np.asarray(keys, np.uint64)
     if not len(keys):
         return keys
+    # dedupe LAST-wins: a concatenated base+delta file naturally repeats
+    # keys, and the table's upsert contract requires unique keys per call
+    # (host_table.py — duplicates would double-insert)
+    last = len(keys) - 1 - np.unique(keys[::-1], return_index=True)[1]
+    if len(last) != len(keys):
+        sel = np.sort(last)
+        keys = keys[sel]
+        shows = [shows[i] for i in sel]
+        clicks = [clicks[i] for i in sel]
+        ws_ = [ws_[i] for i in sel]
+        mfs = [mfs[i] for i in sel]
     rows = engine.table.bulk_pull(keys)     # schema defaults
     rows["show"] = np.asarray(shows, np.float32)
     rows["click"] = np.asarray(clicks, np.float32)

@@ -1,0 +1,147 @@
+"""Graph-embedding training over the sparse PS — the GNN mode's loop.
+
+≙ the reference's graph-learning mode (SURVEY §2.2: GpuPsGraphTable +
+graph_gpu_wrapper walks feeding the SAME sparse embedding PS the CTR
+trainers use — the walk engine produces (center, context) pairs and the
+node embeddings live as PS feature rows).  The loop: random walks over
+the device-resident CSR graph → skip-gram window pairs → pull node mf
+rows from the pass working set → sampled-softmax/NCE loss → adagrad on
+the touched rows' mf (the mf/mf_g2sum rule of optimizer.cuh.h:31 applied
+to the graph embedding field).
+
+TPU-first: one donated jit step over static-shape [B] pair batches —
+pulls are row gathers on the pass-dense working set, the push is the
+grad of the NCE loss scattered by XLA, and walks/pair extraction are
+jit programs on device (graph/graph_table.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.graph.graph_table import GraphTable
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+
+
+def walk_pairs(walks: jnp.ndarray, window: int) -> jnp.ndarray:
+    """[W, L] node walks → [P, 2] (center, context) pairs within the
+    window (≙ the skip-gram pair extraction the walk engine feeds);
+    static P = W * (L - 1 ... ) with invalid (-1-padded) pairs kept and
+    masked by the caller via ids < 0."""
+    w, l = walks.shape
+    pairs = []
+    for off in range(1, window + 1):
+        a = walks[:, :-off].reshape(-1)
+        b = walks[:, off:].reshape(-1)
+        pairs.append(jnp.stack([a, b], 1))
+        pairs.append(jnp.stack([b, a], 1))
+    return jnp.concatenate(pairs, axis=0)
+
+
+class GraphEmbeddingTrainer:
+    """Skip-gram-with-negatives over PS-resident node embeddings."""
+
+    def __init__(self, engine: BoxPSEngine, graph: GraphTable,
+                 n_negatives: int = 5, learning_rate: float = 0.05,
+                 window: int = 2, seed: int = 0):
+        self.engine = engine
+        self.graph = graph
+        self.k = n_negatives
+        self.lr = learning_rate
+        self.window = window
+        self._key = jax.random.PRNGKey(seed)
+        self._step = None
+        self._step_keys = None
+
+    # -- node id → pass row translation (host, once per pass) --------------
+    def node_rows(self, nodes: np.ndarray) -> np.ndarray:
+        """Dense graph node ids → pass working-set rows (nodes are
+        feasigns: the graph and the PS share the key space)."""
+        return self.engine.mapper(np.asarray(nodes, np.uint64))
+
+    def _build_step(self):
+        lr, k = self.lr, self.k
+        # negatives draw from REAL keys only: the working set is padded to
+        # a size bucket, and phantom padding rows would both weaken the
+        # NCE signal and accumulate updates end_pass silently discards
+        n_real = self.engine.num_keys
+        self._step_keys = n_real
+
+        def step(ws, key, centers, contexts):
+            """centers/contexts [B] pass rows (0 = padding row, masked)."""
+            valid = ((centers > 0) & (contexts > 0)).astype(jnp.float32)
+            negs = jax.random.randint(key, (centers.shape[0], k), 1,
+                                      n_real + 1)
+
+            def loss_fn(mf):
+                u = mf[centers]                      # [B, D]
+                v = mf[contexts]
+                vn = mf[negs]                        # [B, K, D]
+                pos = jax.nn.log_sigmoid(
+                    jnp.sum(u * v, -1))              # [B]
+                neg = jax.nn.log_sigmoid(
+                    -jnp.einsum("bd,bkd->bk", u, vn)).sum(-1)
+                denom = jnp.maximum(valid.sum(), 1.0)
+                return -jnp.sum((pos + neg) * valid) / denom
+
+            loss, g = jax.value_and_grad(loss_fn)(ws["mf"])
+            # adagrad on the embedding field (the mf/mf_g2sum rule of
+            # optimizer.cuh.h:31 — row 0 reserved, untouched rows keep
+            # exact-zero grads so their state never moves)
+            g = g.at[0].set(0.0)
+            g2 = ws["mf_g2sum"] + jnp.sum(g * g, -1) / g.shape[1]
+            scale = lr / (jnp.sqrt(g2) + 1e-8)
+            ws = dict(ws)
+            ws["mf"] = ws["mf"] - g * scale[:, None]
+            ws["mf_g2sum"] = g2
+            return ws, loss
+
+        self._step = jax.jit(step, donate_argnums=(0,))
+
+    def train_pairs(self, pairs_rows: jnp.ndarray,
+                    batch_size: int = 4096) -> float:
+        """One epoch over [P, 2] pass-row pairs; returns mean loss."""
+        if self._step is None or self._step_keys != self.engine.num_keys:
+            self._build_step()
+        ws = self.engine.ws
+        losses = []
+        p = pairs_rows.shape[0]
+        for lo in range(0, p, batch_size):
+            chunk = pairs_rows[lo:lo + batch_size]
+            if chunk.shape[0] < batch_size:   # static-shape tail pad
+                pad = jnp.zeros((batch_size - chunk.shape[0], 2),
+                                chunk.dtype)
+                chunk = jnp.concatenate([chunk, pad])
+            self._key, sub = jax.random.split(self._key)
+            ws, loss = self._step(ws, sub, chunk[:, 0], chunk[:, 1])
+            losses.append(loss)
+        self.engine.ws = ws
+        return float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
+
+    def train_walks(self, starts: np.ndarray, length: int = 8,
+                    batch_size: int = 4096,
+                    seed: Optional[int] = None) -> float:
+        """Walks → pairs → one training epoch (the full graph-mode loop).
+        seed None (default) advances the trainer's own RNG so repeated
+        epochs explore NEW walks; pass an explicit seed to reproduce."""
+        if seed is None:
+            self._key, wk = jax.random.split(self._key)
+        else:
+            wk = jax.random.PRNGKey(seed)
+        walks = self.graph.random_walk(
+            jnp.asarray(starts, jnp.int32), length, key=wk)
+        pairs = walk_pairs(walks, self.window)      # dense node ids, -1 pad
+        flat = np.asarray(pairs).reshape(-1)
+        ok = flat >= 0
+        rows = np.zeros_like(flat, dtype=np.int32)
+        rows[ok] = self.node_rows(flat[ok])
+        rows = rows.reshape(pairs.shape)
+        # drop pairs with any invalid side (walk dead-ends) and self-pairs
+        # (stuck walks repeat their node — training u.u would just inflate
+        # norms)
+        both = (rows > 0).all(axis=1) & (rows[:, 0] != rows[:, 1])
+        return self.train_pairs(jnp.asarray(rows[both]), batch_size)

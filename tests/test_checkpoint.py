@@ -100,3 +100,26 @@ def test_xbox_serving_roundtrip(data_file, tmp_path):
     mf_scale = np.abs(v_s[:, 3:]).max() / 32767.0
     np.testing.assert_allclose(v_q[:, 3:], v_s[:, 3:],
                                atol=max(3 * mf_scale, 1e-4))
+
+
+def test_load_xbox_base_plus_delta_last_wins(tmp_path):
+    """A concatenated base+delta dump repeats keys — the LAST occurrence
+    (the delta) must win, matching serving-side refresh semantics."""
+    from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+    from paddlebox_tpu.io.checkpoint import load_xbox
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+
+    path = str(tmp_path / "combined.txt")
+    with open(path, "w") as f:
+        f.write("7\t1\t0\t0.5\t0.1 0.2\n")     # base row
+        f.write("9\t2\t1\t0.3\t0.3 0.4\n")
+        f.write("7\t5\t2\t0.9\t0.7 0.8\n")     # delta overrides key 7
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=2, shard_num=2,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    keys = load_xbox(eng, path)
+    assert sorted(keys.tolist()) == [7, 9]
+    rows = eng.table.bulk_pull(np.array([7, 9], np.uint64))
+    np.testing.assert_allclose(rows["show"], [5, 2])
+    np.testing.assert_allclose(rows["embed_w"], [0.9, 0.3])
+    np.testing.assert_allclose(rows["mf"], [[0.7, 0.8], [0.3, 0.4]])
