@@ -63,6 +63,80 @@ def accumulate_auc(state: Dict[str, jnp.ndarray], pred: jnp.ndarray,
     return {"pos": pos, "neg": neg, "scalars": scalars}
 
 
+class WuAucCalculator:
+    """Per-user AUC family — uauc (mean of per-user AUCs) and wuauc
+    (instance-weighted mean), ≙ WuAucMetricMsg + computeWuAuc /
+    computeSingelUserAuc (metrics.h:287, metrics.cc:501-587).
+
+    TPU-first shape: the reference sorts a record vector and walks each
+    user's ROC with a tie-merging loop; here per-user AUC is the
+    Mann-Whitney statistic with average ranks for pred ties (identical to
+    the tie-merged trapezoid — tests diff against a transliteration of
+    the reference loop), computed with vectorized lexsort + segment
+    cumsums over ALL users at once.  Single-class users are skipped
+    exactly like the reference's auc == -1 branch."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._uid: List[np.ndarray] = []
+        self._pred: List[np.ndarray] = []
+        self._label: List[np.ndarray] = []
+
+    def add_data(self, pred, label, uid, mask=None) -> None:
+        pred = np.clip(np.asarray(pred, np.float64), 0.0, 1.0)
+        label = np.asarray(label, np.int64)
+        uid = np.asarray(uid, np.uint64)
+        if mask is not None:
+            keep = np.asarray(mask, bool)
+            pred, label, uid = pred[keep], label[keep], uid[keep]
+        self._pred.append(pred)
+        self._label.append(label)
+        self._uid.append(uid)
+
+    def compute(self) -> Dict[str, float]:
+        if not self._pred or not sum(len(p) for p in self._pred):
+            return {"uauc": 0.0, "wuauc": 0.0, "user_cnt": 0.0,
+                    "size": 0.0}
+        pred = np.concatenate(self._pred)
+        label = np.concatenate(self._label)
+        uid = np.concatenate(self._uid)
+        order = np.lexsort((pred, uid))
+        u, p, l = uid[order], pred[order], label[order]
+        n = len(u)
+        new_user = np.empty(n, bool)
+        new_user[0] = True
+        np.not_equal(u[1:], u[:-1], out=new_user[1:])
+        user_id = np.cumsum(new_user) - 1
+        n_users = int(user_id[-1]) + 1
+        first = np.nonzero(new_user)[0]
+        pos_in_user = np.arange(n) - first[user_id] + 1    # 1-based rank
+        # pred-tie groups within a user share the AVERAGE rank
+        new_grp = new_user | np.concatenate([[True], p[1:] != p[:-1]])
+        gid = np.cumsum(new_grp) - 1
+        cnt_g = np.bincount(gid)
+        avg_rank = np.bincount(gid, weights=pos_in_user) / cnt_g
+        rank = avg_rank[gid]
+
+        cnt_u = np.bincount(user_id, minlength=n_users).astype(np.float64)
+        npos = np.bincount(user_id, weights=l, minlength=n_users)
+        nneg = cnt_u - npos
+        pos_rank_sum = np.bincount(user_id, weights=rank * l,
+                                   minlength=n_users)
+        ok = (npos > 0) & (nneg > 0)
+        auc_u = np.zeros(n_users)
+        auc_u[ok] = (pos_rank_sum[ok] - npos[ok] * (npos[ok] + 1) / 2.0) \
+            / (npos[ok] * nneg[ok])
+        user_cnt = float(ok.sum())
+        size = float(cnt_u[ok].sum())
+        return {
+            "uauc": float(auc_u[ok].sum() / max(user_cnt, 1.0)),
+            "wuauc": float((auc_u[ok] * cnt_u[ok]).sum() / max(size, 1.0)),
+            "user_cnt": user_cnt, "size": size,
+        }
+
+
 def allreduce_auc_state(state, client, world: int, key: str):
     """EXACT cross-process metrics: sum the pos/neg bucket tables + scalar
     sums over every worker through the PS service's keyed allreduce, so
@@ -182,11 +256,17 @@ class MetricGroup:
     def init_metric(self, name: str, label_var: str = "label",
                     pred_var: str = "prob", phase: int = -1,
                     cmatch_rank_group: str = "", ignore_rank: bool = False,
-                    table_size: int = TABLE_SIZE) -> None:
+                    table_size: int = TABLE_SIZE,
+                    metric_type: str = "auc",
+                    uid_var: str = "") -> None:
         """cmatch_rank_group: "222:1,223:2" keeps records whose
         (cmatch, rank) is listed; "222,223" (or ignore_rank) filters on
         cmatch only (≙ CmatchRankAucCalculator / MetricMsg variants,
-        metrics.h:204+)."""
+        metrics.h:204+).  metric_type "wuauc" registers the per-user AUC
+        family instead (≙ WuAucMetricMsg, metrics.h:287) — update() then
+        requires uid."""
+        if metric_type not in ("auc", "wuauc"):
+            raise ValueError(f"unknown metric_type {metric_type!r}")
         pairs = []
         for tok in cmatch_rank_group.split(","):
             tok = tok.strip()
@@ -198,7 +278,9 @@ class MetricGroup:
             else:
                 pairs.append((int(tok.split(":")[0]), None))
         self._metrics[name] = {
-            "calc": AucCalculator(table_size),
+            "calc": (WuAucCalculator() if metric_type == "wuauc"
+                     else AucCalculator(table_size)),
+            "type": metric_type, "uid_var": uid_var,
             "label_var": label_var, "pred_var": pred_var, "phase": phase,
             "cmatch_rank": pairs,
         }
@@ -211,7 +293,7 @@ class MetricGroup:
                 if m["phase"] in (-1, self.phase)]
 
     def update(self, name: str, pred, label, mask=None,
-               cmatch=None, rank=None) -> None:
+               cmatch=None, rank=None, uid=None) -> None:
         """mask/cmatch/rank filtering (≙ add_mask_data metrics.cc:164 and
         the cmatch_rank MetricMsg update loop)."""
         m = self._metrics[name]
@@ -227,12 +309,28 @@ class MetricGroup:
             for c, r in m["cmatch_rank"]:
                 sel |= (cm == c) if r is None else ((cm == c) & (rk == r))
             keep &= sel
-        m["calc"].add_data(pred, label, keep)
+        if m.get("type") == "wuauc":
+            if uid is None:
+                raise ValueError(
+                    f"metric {name!r} is wuauc — update() requires uid")
+            m["calc"].add_data(pred, label, uid, keep)
+        else:
+            m["calc"].add_data(pred, label, keep)
 
     def merge_device_state(self, name: str, state) -> None:
-        self._metrics[name]["calc"].merge_device_state(state)
+        m = self._metrics[name]
+        if m.get("type") == "wuauc":
+            raise ValueError(
+                f"metric {name!r} is wuauc — it accumulates host-side "
+                "(uid, label, pred) records, not device bucket tables; "
+                "feed it via update(..., uid=...).  Cross-worker "
+                "aggregation needs the records gathered (variable "
+                "length), which the fixed-shape PS allreduce does not "
+                "carry — compute wuauc per worker or gather records "
+                "upstream")
+        m["calc"].merge_device_state(state)
 
-    def calculator(self, name: str) -> AucCalculator:
+    def calculator(self, name: str) -> "AucCalculator | WuAucCalculator":
         return self._metrics[name]["calc"]
 
     def get_metric_msg(self, name: str) -> Dict[str, float]:
