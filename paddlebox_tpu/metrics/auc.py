@@ -22,17 +22,18 @@ import jax
 import jax.numpy as jnp
 
 TABLE_SIZE = 1_000_000  # ≙ box_wrapper.h:786
+N_SCALARS = 6   # abserr, sqrerr, pred_sum, label_sum, total, nan_inf
 K_RELATIVE_ERROR_BOUND = 0.05  # ≙ metrics.h:193
 K_MAX_SPAN = 0.01              # ≙ metrics.h:194
 
 
 def make_auc_state(table_size: int = TABLE_SIZE) -> Dict[str, jnp.ndarray]:
     """Device-side accumulator pytree: pos/neg bucket tables + scalar sums
-    [abserr, sqrerr, pred_sum, label_sum, total]."""
+    [abserr, sqrerr, pred_sum, label_sum, total, nan_inf]."""
     return {
         "pos": jnp.zeros((table_size,), jnp.float32),
         "neg": jnp.zeros((table_size,), jnp.float32),
-        "scalars": jnp.zeros((5,), jnp.float32),
+        "scalars": jnp.zeros((N_SCALARS,), jnp.float32),
     }
 
 
@@ -43,12 +44,19 @@ def accumulate_auc(state: Dict[str, jnp.ndarray], pred: jnp.ndarray,
     vectorized).  pred/label: [B]; mask False drops padded records
     (≙ add_mask_data metrics.cc:164)."""
     table_size = state["pos"].shape[0]
-    pred = jnp.clip(pred.astype(jnp.float32), 0.0, 1.0)
+    pred = pred.astype(jnp.float32)
+    # non-finite preds must not poison the buckets (NaN -> undefined int
+    # cast): count them separately (≙ add_nan_inf_data metrics.cc:452)
+    # and drop them from every other statistic
+    finite = jnp.isfinite(pred)
+    pred = jnp.clip(jnp.where(finite, pred, 0.0), 0.0, 1.0)
     label = label.astype(jnp.float32)
     if mask is None:
         w = jnp.ones_like(pred)
     else:
         w = mask.astype(jnp.float32)
+    nan_inf = jnp.sum(w * (1.0 - finite.astype(jnp.float32)))
+    w = w * finite.astype(jnp.float32)
     bucket = jnp.clip((pred * table_size).astype(jnp.int32), 0, table_size - 1)
     pos = state["pos"].at[bucket].add(w * label)
     neg = state["neg"].at[bucket].add(w * (1.0 - label))
@@ -59,6 +67,7 @@ def accumulate_auc(state: Dict[str, jnp.ndarray], pred: jnp.ndarray,
         jnp.sum(w * pred),
         jnp.sum(w * label),
         jnp.sum(w),
+        nan_inf,
     ])
     return {"pos": pos, "neg": neg, "scalars": scalars}
 
@@ -83,22 +92,31 @@ class WuAucCalculator:
         self._uid: List[np.ndarray] = []
         self._pred: List[np.ndarray] = []
         self._label: List[np.ndarray] = []
+        self._nan_inf = 0.0
 
     def add_data(self, pred, label, uid, mask=None) -> None:
-        pred = np.clip(np.asarray(pred, np.float64), 0.0, 1.0)
+        pred = np.asarray(pred, np.float64)
         label = np.asarray(label, np.int64)
         uid = np.asarray(uid, np.uint64)
         if mask is not None:
             keep = np.asarray(mask, bool)
             pred, label, uid = pred[keep], label[keep], uid[keep]
-        self._pred.append(pred)
+        # same invariant as AucCalculator: non-finite preds are counted,
+        # never ranked (a NaN would lexsort to the top rank and inflate
+        # the diverging model's per-user AUC)
+        finite = np.isfinite(pred)
+        if not finite.all():
+            self._nan_inf += float((~finite).sum())
+            pred, label, uid = pred[finite], label[finite], uid[finite]
+        self._pred.append(np.clip(pred, 0.0, 1.0))
         self._label.append(label)
         self._uid.append(uid)
 
     def compute(self) -> Dict[str, float]:
         if not self._pred or not sum(len(p) for p in self._pred):
             return {"uauc": 0.0, "wuauc": 0.0, "user_cnt": 0.0,
-                    "size": 0.0}
+                    "size": 0.0, "nan_inf_rate": 1.0 if self._nan_inf
+                    else 0.0}
         pred = np.concatenate(self._pred)
         label = np.concatenate(self._label)
         uid = np.concatenate(self._uid)
@@ -134,6 +152,9 @@ class WuAucCalculator:
             "uauc": float(auc_u[ok].sum() / max(user_cnt, 1.0)),
             "wuauc": float((auc_u[ok] * cnt_u[ok]).sum() / max(size, 1.0)),
             "user_cnt": user_cnt, "size": size,
+            "nan_inf_rate": float(
+                self._nan_inf / (n + self._nan_inf)) if self._nan_inf
+            else 0.0,
         }
 
 
@@ -164,21 +185,27 @@ class AucCalculator:
     def reset(self) -> None:
         self._pos = np.zeros((self.table_size,), np.float64)
         self._neg = np.zeros((self.table_size,), np.float64)
-        self._scalars = np.zeros((5,), np.float64)
+        self._scalars = np.zeros((N_SCALARS,), np.float64)
 
     # -- host-side add (small batches / tests) ------------------------------
     def add_data(self, pred, label, mask=None) -> None:
-        pred = np.clip(np.asarray(pred, np.float64), 0.0, 1.0)
+        pred = np.asarray(pred, np.float64)
         label = np.asarray(label, np.float64)
         w = np.ones_like(pred) if mask is None else \
             np.asarray(mask, np.float64)
+        # finite check BEFORE the clip (clip would turn +inf into 1.0)
+        finite = np.isfinite(pred)
+        pred = np.clip(np.where(finite, pred, 0.0), 0.0, 1.0)
+        nan_inf = np.sum(w * (1.0 - finite))
+        w = w * finite
         bucket = np.clip((pred * self.table_size).astype(np.int64), 0,
                          self.table_size - 1)
         np.add.at(self._pos, bucket, w * label)
         np.add.at(self._neg, bucket, w * (1.0 - label))
         err = pred - label
         self._scalars += [np.sum(w * np.abs(err)), np.sum(w * err * err),
-                          np.sum(w * pred), np.sum(w * label), np.sum(w)]
+                          np.sum(w * pred), np.sum(w * label), np.sum(w),
+                          nan_inf]
 
     # -- merge device accumulator state -------------------------------------
     def merge_device_state(self, state) -> None:
@@ -201,7 +228,7 @@ class AucCalculator:
         else:
             auc = area / (fp * tp)
         size = fp + tp
-        abserr, sqrerr, pred_sum, label_sum, total = self._scalars
+        abserr, sqrerr, pred_sum, label_sum, total, nan_inf = self._scalars
         out = {
             "auc": float(auc),
             "size": float(size),
@@ -210,6 +237,10 @@ class AucCalculator:
             "actual_ctr": float(tp / size) if size else 0.0,
             "predicted_ctr": float(pred_sum / size) if size else 0.0,
             "bucket_error": self._bucket_error(),
+            # ≙ nan_inf_rate (metrics.h:116): non-finite preds are counted
+            # out of the other statistics, never bucketed
+            "nan_inf_rate": float(nan_inf / (size + nan_inf))
+            if (size + nan_inf) else 0.0,
         }
         return out
 

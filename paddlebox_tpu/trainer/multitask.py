@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import optax
 
 from paddlebox_tpu.data.batch_pack import BatchPacker
+from paddlebox_tpu.metrics import auc as auc_mod
 from paddlebox_tpu.metrics.auc import AucCalculator, accumulate_auc
 from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
 from paddlebox_tpu.ps import embedding, optimizer as sparse_opt
@@ -30,7 +31,7 @@ def make_multi_auc_state(n_tasks: int, table_size: int):
     return {
         "pos": jnp.zeros((n_tasks, table_size), jnp.float32),
         "neg": jnp.zeros((n_tasks, table_size), jnp.float32),
-        "scalars": jnp.zeros((n_tasks, 5), jnp.float32),
+        "scalars": jnp.zeros((n_tasks, auc_mod.N_SCALARS), jnp.float32),
     }
 
 

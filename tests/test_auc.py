@@ -92,3 +92,40 @@ def test_metric_group_phases():
     assert set(g.active()) == {"auc_update", "auc_all"}
     g.update("auc_all", [0.2, 0.8], [0, 1])
     assert g.get_metric_msg("auc_all")["auc"] == 1.0
+
+
+def test_non_finite_preds_counted_not_bucketed():
+    import pytest
+    """A NaN/Inf pred must not poison the AUC buckets (≙ add_nan_inf_data
+    metrics.cc:452 — counted into nan_inf_rate, dropped from all other
+    statistics)."""
+    import jax.numpy as jnp
+    from paddlebox_tpu.metrics.auc import (AucCalculator, accumulate_auc,
+                                           make_auc_state)
+
+    rng = np.random.default_rng(0)
+    pred = rng.random(64).astype(np.float32)
+    label = (rng.random(64) < pred).astype(np.float32)
+    bad = pred.copy()
+    bad[5] = np.nan
+    bad[17] = np.inf
+
+    # device accumulator path
+    st_clean = accumulate_auc(make_auc_state(1000), jnp.asarray(pred),
+                              jnp.asarray(label))
+    st_bad = accumulate_auc(make_auc_state(1000), jnp.asarray(bad),
+                            jnp.asarray(label))
+    calc_c, calc_b = AucCalculator(1000), AucCalculator(1000)
+    calc_c.merge_device_state(st_clean)
+    calc_b.merge_device_state(st_bad)
+    a, b = calc_c.compute(), calc_b.compute()
+    assert np.isfinite(b["auc"]) and b["size"] == 62
+    assert b["nan_inf_rate"] == pytest.approx(2 / 64)
+    assert a["nan_inf_rate"] == 0.0
+
+    # host path agrees
+    host = AucCalculator(1000)
+    host.add_data(bad, label)
+    h = host.compute()
+    assert h["nan_inf_rate"] == pytest.approx(2 / 64)
+    assert np.isclose(h["auc"], b["auc"], atol=1e-6)

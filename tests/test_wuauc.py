@@ -83,7 +83,8 @@ def test_single_class_users_skipped():
 
 def test_empty():
     out = WuAucCalculator().compute()
-    assert out == {"uauc": 0.0, "wuauc": 0.0, "user_cnt": 0.0, "size": 0.0}
+    assert out == {"uauc": 0.0, "wuauc": 0.0, "user_cnt": 0.0, "size": 0.0,
+                   "nan_inf_rate": 0.0}
 
 
 def test_metric_group_registration():
@@ -107,3 +108,15 @@ def test_merge_device_state_rejected_for_wuauc():
     g.init_metric("w", metric_type="wuauc")
     with pytest.raises(ValueError, match="host-side"):
         g.merge_device_state("w", {"pos": np.zeros(4)})
+
+
+def test_non_finite_preds_dropped():
+    calc = WuAucCalculator()
+    calc.add_data([0.5, np.nan, np.inf], [0, 1, 1], [7, 7, 7])
+    out = calc.compute()
+    # the only finite record is single-class -> no qualifying user
+    assert out["user_cnt"] == 0.0 and out["nan_inf_rate"] == pytest.approx(
+        2 / 3)
+    calc2 = WuAucCalculator()
+    calc2.add_data([np.nan], [1], [3])
+    assert calc2.compute()["nan_inf_rate"] == 1.0
