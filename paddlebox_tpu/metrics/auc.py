@@ -289,15 +289,36 @@ class MetricGroup:
                     cmatch_rank_group: str = "", ignore_rank: bool = False,
                     table_size: int = TABLE_SIZE,
                     metric_type: str = "auc",
-                    uid_var: str = "") -> None:
+                    uid_var: str = "",
+                    multitask_group: str = "") -> None:
         """cmatch_rank_group: "222:1,223:2" keeps records whose
         (cmatch, rank) is listed; "222,223" (or ignore_rank) filters on
         cmatch only (≙ CmatchRankAucCalculator / MetricMsg variants,
         metrics.h:204+).  metric_type "wuauc" registers the per-user AUC
         family instead (≙ WuAucMetricMsg, metrics.h:287) — update() then
-        requires uid."""
-        if metric_type not in ("auc", "wuauc"):
+        requires uid.  metric_type "multi_task" (≙ MultiTaskMetricMsg,
+        metrics.h:327): multitask_group maps (cmatch, rank) pairs
+        ("222_0,223_0") to pred COLUMNS — each instance scores with the
+        task column its cmatch selects, into one shared calculator."""
+        if metric_type not in ("auc", "wuauc", "multi_task"):
             raise ValueError(f"unknown metric_type {metric_type!r}")
+        task_pairs = []
+        if metric_type == "multi_task":
+            for tok in multitask_group.split(","):
+                tok = tok.strip()
+                if not tok:
+                    continue
+                parts = tok.split("_")
+                if len(parts) != 2:
+                    raise ValueError(
+                        f"multitask_group token {tok!r}: expected "
+                        "'cmatch_rank' (e.g. '222_0')")
+                task_pairs.append((int(parts[0]), int(parts[1])))
+            if not task_pairs:
+                raise ValueError(
+                    "metric_type='multi_task' needs multitask_group "
+                    "(e.g. '222_0,223_0' — one cmatch_rank per pred "
+                    "column)")
         pairs = []
         for tok in cmatch_rank_group.split(","):
             tok = tok.strip()
@@ -313,7 +334,7 @@ class MetricGroup:
                      else AucCalculator(table_size)),
             "type": metric_type, "uid_var": uid_var,
             "label_var": label_var, "pred_var": pred_var, "phase": phase,
-            "cmatch_rank": pairs,
+            "cmatch_rank": pairs, "task_pairs": task_pairs,
         }
 
     def flip_phase(self) -> None:
@@ -345,6 +366,28 @@ class MetricGroup:
                 raise ValueError(
                     f"metric {name!r} is wuauc — update() requires uid")
             m["calc"].add_data(pred, label, uid, keep)
+        elif m.get("type") == "multi_task":
+            # each instance scores with the pred COLUMN its (cmatch, rank)
+            # selects (first match, ≙ the std::find loop metrics.h:394);
+            # unmatched instances are skipped
+            if pred.ndim != 2 or cmatch is None:
+                raise ValueError(
+                    f"metric {name!r} is multi_task — update() needs "
+                    "pred [B, T] and cmatch")
+            if len(m["task_pairs"]) > pred.shape[1]:
+                raise ValueError(
+                    f"metric {name!r}: {len(m['task_pairs'])} multitask "
+                    f"pairs but pred has only {pred.shape[1]} columns")
+            cm = np.asarray(cmatch)
+            rk = (np.asarray(rank) if rank is not None
+                  else np.zeros(len(cm), np.int64))
+            sel = np.full(pred.shape[0], -1, np.int64)
+            for t, (c, r) in enumerate(m["task_pairs"]):
+                hit = (cm == c) & (rk == r) & (sel < 0)
+                sel[hit] = t
+            pick = (sel >= 0) & keep
+            m["calc"].add_data(pred[np.nonzero(pick)[0], sel[pick]],
+                               np.asarray(label)[pick])
         else:
             m["calc"].add_data(pred, label, keep)
 

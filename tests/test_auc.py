@@ -95,10 +95,10 @@ def test_metric_group_phases():
 
 
 def test_non_finite_preds_counted_not_bucketed():
-    import pytest
     """A NaN/Inf pred must not poison the AUC buckets (≙ add_nan_inf_data
     metrics.cc:452 — counted into nan_inf_rate, dropped from all other
     statistics)."""
+    import pytest
     import jax.numpy as jnp
     from paddlebox_tpu.metrics.auc import (AucCalculator, accumulate_auc,
                                            make_auc_state)

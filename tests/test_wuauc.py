@@ -120,3 +120,46 @@ def test_non_finite_preds_dropped():
     calc2 = WuAucCalculator()
     calc2.add_data([np.nan], [1], [3])
     assert calc2.compute()["nan_inf_rate"] == 1.0
+
+
+def test_multi_task_metric_selects_task_column():
+    """MultiTaskMetricMsg semantics (metrics.h:327): each instance scores
+    with the pred column selected by its (cmatch, rank); unmatched
+    instances are skipped; all pairs share one calculator."""
+    from paddlebox_tpu.metrics.auc import AucCalculator
+
+    g = MetricGroup()
+    g.init_metric("mt", metric_type="multi_task",
+                  multitask_group="222_0,223_0")
+    rng = np.random.default_rng(1)
+    B = 200
+    preds = rng.random((B, 2))
+    cmatch = rng.choice([222, 223, 999], size=B)
+    task = np.where(cmatch == 222, 0, 1)
+    true_pred = preds[np.arange(B), task]
+    label = (rng.random(B) < true_pred).astype(np.int64)
+    g.update("mt", preds, label, cmatch=cmatch)
+
+    ref = AucCalculator(1_000_000)
+    m = cmatch != 999
+    ref.add_data(true_pred[m], label[m])
+    np.testing.assert_allclose(g.get_metric_msg("mt")["auc"],
+                               ref.compute()["auc"], atol=1e-12)
+    assert g.get_metric_msg("mt")["size"] == m.sum()
+
+    with pytest.raises(ValueError, match="multi_task"):
+        g.update("mt", preds[:, 0], label, cmatch=cmatch)
+    with pytest.raises(ValueError, match="multitask_group"):
+        g.init_metric("bad2", metric_type="multi_task")
+
+
+def test_multi_task_pair_count_exceeds_columns_fails_fast():
+    g = MetricGroup()
+    g.init_metric("mt3", metric_type="multi_task",
+                  multitask_group="222_0,223_0,224_0")
+    with pytest.raises(ValueError, match="columns"):
+        g.update("mt3", np.zeros((4, 2)), np.zeros(4),
+                 cmatch=np.full(4, 222))
+    with pytest.raises(ValueError, match="cmatch_rank"):
+        g.init_metric("bad3", metric_type="multi_task",
+                      multitask_group="222")
