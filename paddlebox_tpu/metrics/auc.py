@@ -319,6 +319,10 @@ class MetricGroup:
                     "metric_type='multi_task' needs multitask_group "
                     "(e.g. '222_0,223_0' — one cmatch_rank per pred "
                     "column)")
+        elif multitask_group:
+            raise ValueError(
+                "multitask_group is only meaningful with "
+                "metric_type='multi_task'")
         pairs = []
         for tok in cmatch_rank_group.split(","):
             tok = tok.strip()

@@ -1,0 +1,127 @@
+"""The production day workflow as ONE scenario — the reference operator's
+actual loop (SURVEY §3.2 pass lifecycle + §5 checkpoint/serving):
+
+  day 1: join pass -> flip -> update pass, phase-filtered metrics,
+         save_base + xbox dump, shrink
+  restart: checkpoint save -> fresh process state -> resume
+  day 2: another pass on restored state (AUC keeps learning)
+  serving: load the xbox dump into a serving engine, frozen int16 pulls
+
+Cross-feature interactions (metrics registry x phase flips x persistence
+x serving handoff) only show up when the whole journey runs in order.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu import fleet
+from paddlebox_tpu.config import (EmbeddingTableConfig, SparseSGDConfig)
+from paddlebox_tpu.io.checkpoint import (TrainCheckpoint, load_xbox,
+                                         save_xbox)
+from paddlebox_tpu.metrics.auc import MetricGroup
+from paddlebox_tpu.models.widedeep import WideDeep
+from paddlebox_tpu.ps import embedding
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.trainer.trainer import SparseTrainer
+from tests.test_end_to_end import feed_config, gen_data, MF_DIM, N_SLOTS
+
+
+@pytest.fixture(scope="module")
+def data_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("day") / "pass-0.txt"
+    gen_data(str(p), n=1200, seed=11)
+    return str(p)
+
+
+def _make(engine=None):
+    f = fleet.init()
+    engine = engine or f.init_engine(EmbeddingTableConfig(
+        embedding_dim=MF_DIM, shard_num=4,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    cfg = feed_config()
+    ds = fleet.DatasetFactory().create_dataset("BoxPSDataset",
+                                               feed_config=cfg,
+                                               engine=engine)
+    model = WideDeep(num_slots=N_SLOTS, emb_width=3 + MF_DIM, dense_dim=2,
+                     hidden=(32, 16))
+    tr = SparseTrainer(engine, model, cfg, batch_size=128,
+                       auc_table_size=10_000)
+    return engine, ds, tr
+
+
+def test_full_day_workflow(data_file, tmp_path):
+    engine, ds, tr = _make()
+    ds.set_filelist([data_file])
+
+    metrics = MetricGroup()
+    metrics.init_metric("join_auc", phase=1, table_size=10_000)
+    metrics.init_metric("update_auc", phase=0, table_size=10_000)
+    metrics.phase = 1
+
+    def run_pass():
+        ds.load_into_memory()
+        ds.local_shuffle()
+        ds.begin_pass()
+        tr.reset_metrics()
+        out = fleet.train_from_dataset(tr, ds)
+        for name in metrics.active():
+            # phase-filtered registry rides the pass metrics
+            metrics.calculator(name).merge_device_state(
+                jax.device_get(tr.auc_state))
+        ds.end_pass()
+        return out
+
+    # -- day 1: join then update phase ---------------------------------
+    ds.set_date("20260729")
+    out_join = run_pass()
+    engine.flip_phase()
+    metrics.flip_phase()
+    out_update = run_pass()
+    assert np.isfinite(out_join["loss"]) and np.isfinite(out_update["loss"])
+    j = metrics.get_metric_msg("join_auc")
+    u = metrics.get_metric_msg("update_auc")
+    assert j["size"] > 0 and u["size"] > 0
+
+    base_saved = engine.save_base(str(tmp_path / "base"))
+    xbox_path = str(tmp_path / "xbox" / "base.txt")
+    n_xbox = save_xbox(engine, xbox_path, base=True)
+    assert base_saved >= 0 and n_xbox > 0
+    removed = engine.shrink()
+    assert removed >= 0 and engine.table.size() > 0
+
+    ckpt = TrainCheckpoint(str(tmp_path / "ckpt"))
+    ckpt.save(engine, tr, extra={"day": "20260729"})
+
+    # -- restart: fresh objects resume the checkpoint -------------------
+    engine2, ds2, tr2 = _make()
+    ds2.set_filelist([data_file])
+    state = ckpt.resume(engine2, tr2)
+    assert state["day"] == "20260729"
+    assert engine2.table.size() == engine.table.size()
+
+    # -- day 2 on restored state ---------------------------------------
+    ds2.set_date("20260730")
+    ds2.load_into_memory()
+    ds2.begin_pass()
+    tr2.reset_metrics()
+    out2 = fleet.train_from_dataset(tr2, ds2)
+    ds2.end_pass()
+    assert np.isfinite(out2["loss"])
+    assert out2["auc"] > 0.55, out2   # restored model still discriminates
+
+    # -- serving handoff -----------------------------------------------
+    srv = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=MF_DIM, shard_num=4,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    keys = load_xbox(srv, xbox_path)
+    assert len(keys) == n_xbox
+    srv.begin_feed_pass()
+    srv.add_keys(keys)
+    srv.end_feed_pass()
+    srv.begin_pass()
+    srv.freeze_for_serving()
+    idx = jnp.asarray(srv.mapper(keys[:8]).reshape(1, -1, 1))
+    v = np.asarray(embedding.pull_sparse(srv.ws, idx))
+    assert np.isfinite(v).all()

@@ -163,3 +163,9 @@ def test_multi_task_pair_count_exceeds_columns_fails_fast():
     with pytest.raises(ValueError, match="cmatch_rank"):
         g.init_metric("bad3", metric_type="multi_task",
                       multitask_group="222")
+
+
+def test_multitask_group_rejected_without_type():
+    g = MetricGroup()
+    with pytest.raises(ValueError, match="multi_task"):
+        g.init_metric("x", multitask_group="222_0")
