@@ -18,9 +18,10 @@ the kernels' hi/lo bf16 split carries ~1e-5 relative error).
 Layout notes: occurrence order is canonical [S, L, B] flattened; the plan's
 `perm`/`inv_perm` move between canonical and sorted domains (one XLA row
 gather each way, the only serial-ish ops left, ~2.6ms at 426k rows).  The
-pull table is feature-major [12, n_kernel] (rows: show, click, embed_w,
-mf×D, mf_size) so kernel blocks tile perfectly and the build is 12 row
-writes, not an [N, D] relayout.
+pull table is feature-major [W, n_kernel] with W = 3 + D (+ Dex) + 1
+(rows: show, click, embed_w, mf×D, optional expand mf_ex×Dex, mf_size) so
+kernel blocks tile perfectly and the build is W row writes, not an
+[N, D] relayout.
 """
 
 from __future__ import annotations
@@ -55,17 +56,29 @@ def plan_eff_dims(plan, dims: sp.SpmmDims) -> Optional[sp.SpmmDims]:
     return sp.with_p_pad(dims, n_chunks * dims.chunk)
 
 
+def _ex_dim(ws: Dict[str, jnp.ndarray]) -> int:
+    """Expand ("NNCross") embedding width, 0 without one — the ex columns
+    ride the same feature-major table/payload directly after mf, so the
+    kernels (width-agnostic) and the pooling (everything between col 3 and
+    the trailing mf_size is an embedding masked by created) need no
+    branches."""
+    return ws["mf_ex"].shape[1] if "mf_ex" in ws else 0
+
+
 def _pull_table(ws: Dict[str, jnp.ndarray], dims: sp.SpmmDims) -> jnp.ndarray:
-    """Feature-major pull view [3 + D + 1, n_kernel]."""
+    """Feature-major pull view [3 + D (+ Dex) + 1, n_kernel]."""
     from paddlebox_tpu.ps.embedding import mf_values
     n = ws["show"].shape[0]
     d = ws["mf"].shape[1]
-    tab = jnp.zeros((3 + d + 1, dims.n_kernel), jnp.float32)
+    dx = _ex_dim(ws)
+    tab = jnp.zeros((3 + d + dx + 1, dims.n_kernel), jnp.float32)
     tab = tab.at[0, :n].set(ws["show"])
     tab = tab.at[1, :n].set(ws["click"])
     tab = tab.at[2, :n].set(ws["embed_w"])
     tab = tab.at[3:3 + d, :n].set(mf_values(ws, ws["mf"]).T)
-    tab = tab.at[3 + d, :n].set(ws["mf_size"].astype(jnp.float32))
+    if dx:
+        tab = tab.at[3 + d:3 + d + dx, :n].set(ws["mf_ex"].T)
+    tab = tab.at[3 + d + dx, :n].set(ws["mf_size"].astype(jnp.float32))
     return tab
 
 
@@ -111,17 +124,25 @@ def push_payload(d_pooled: jnp.ndarray, ins_cvm: jnp.ndarray,
          slot_col[..., None]], axis=-1)                    # [S,L,B,D+4]
 
 
-def acc_from_delta(delta: jnp.ndarray, n: int) -> Dict[str, jnp.ndarray]:
+def acc_from_delta(delta: jnp.ndarray, n: int,
+                   d_main: int = None) -> Dict[str, jnp.ndarray]:
     """Merged per-row accumulators for ps.optimizer.apply_push from the
-    scatter output [D+4, >=n] (slot column already first-occurrence-exact)."""
+    scatter output [D(+Dex)+4, >=n] (slot column already
+    first-occurrence-exact).  d_main: the mf width when the payload also
+    carries expand-embedding columns (they split into g_embedx_ex)."""
     d = delta.shape[0] - 4
-    return {
+    if d_main is None:
+        d_main = d
+    acc = {
         "g_show": delta[0, :n],
         "g_click": delta[1, :n],
         "g_embed": delta[2, :n],
-        "g_embedx": delta[3:3 + d, :n].T,
+        "g_embedx": delta[3:3 + d_main, :n].T,
         "slot": jnp.rint(delta[d + 3, :n]).astype(jnp.int32),
     }
+    if d_main < d:
+        acc["g_embedx_ex"] = delta[3 + d_main:3 + d, :n].T
+    return acc
 
 
 def pull_pool_cvm(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
@@ -138,12 +159,12 @@ def pull_pool_cvm(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
     from paddlebox_tpu.ops import crossing as cx
     assert crossing in ("take", "sort"), crossing
     s, l, b = shape_slb
-    d = ws["mf"].shape[1]
+    d = ws["mf"].shape[1] + _ex_dim(ws)
     rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
     eff = plan_eff_dims(plan, dims)
     tab = _pull_table(ws, dims)
     g = sp.gather_sorted(tab, rows2d, ch, tl, fg, eff or dims,
-                         interpret=interpret)              # [12, p_pad]
+                         interpret=interpret)              # [W, p_pad]
     w = 3 + d + 1
     if crossing == "sort":
         if eff is not None:
@@ -151,9 +172,9 @@ def pull_pool_cvm(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
             # exactly the value row 0 holds
             p0 = dims.p_pad - eff.p_pad
             g = jnp.concatenate([jnp.zeros((w, p0), g.dtype), g], axis=1)
-        v = cx.permute_by_dest(tuple(g[:, :dims.p]), perm).T  # [p, 12]
+        v = cx.permute_by_dest(tuple(g[:, :dims.p]), perm).T  # [p, W]
     elif eff is None:
-        v = jnp.take(g.T[:dims.p], inv_perm, axis=0)       # canonical [p,12]
+        v = jnp.take(g.T[:dims.p], inv_perm, axis=0)       # canonical [p,W]
     else:
         # trimmed plan: dropped positions (inv_perm < 0) were row-0
         # occurrences whose pull value is exactly zero — clamp + mask
@@ -180,7 +201,7 @@ def push_and_update(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
     from paddlebox_tpu.ops import crossing as cx
     assert crossing in ("take", "sort"), crossing
     s, l, b = idx_slb.shape
-    d = ws["mf"].shape[1]
+    d = ws["mf"].shape[1] + _ex_dim(ws)
     n = ws["show"].shape[0]
     w = d + 4
     rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
@@ -219,4 +240,5 @@ def push_and_update(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
     srt_cm = srt_cm.at[w - 1, :].mul(first_occ)
     delta = sp.scatter_add_sorted(srt_cm, rows2d, ch, tl, fs, kd,
                                   interpret=interpret)     # [D+4, n_kernel]
-    return sparse_opt.apply_push(ws, acc_from_delta(delta, n), cfg)
+    acc = acc_from_delta(delta, n, d_main=ws["mf"].shape[1])
+    return sparse_opt.apply_push(ws, acc, cfg)

@@ -158,7 +158,17 @@ class SparseTrainer:
                 # fast_path=False is the documented escape hatch to the
                 # numerically-exact reference step — honor it
                 path = "reference"
-            elif not has_ex and self.topology is None:
+            elif has_ex and self._dym_mask is not None:
+                # no path trains mf_ex under per-slot dynamic dims (fast/
+                # reference pull only 3+D columns) — fail with the clear
+                # error instead of an in-jit shape mismatch downstream
+                raise ValueError(
+                    "extended (mf_ex) tables do not compose with per-slot "
+                    "dynamic mf dims — drop slot_mf_dims or the expand "
+                    "embedding")
+            elif self.topology is None:
+                # extended (mf_ex) tables ride the mxu kernels too — the
+                # ex columns join the feature-major table/payload
                 path = "mxu"
             elif not has_ex and self._mxu_shardable():
                 # explicit HeterComm-style exchange: row-sharded table,
@@ -197,15 +207,17 @@ class SparseTrainer:
         has_ex = "mf_ex" in self.engine.ws
         is_adagrad = self.engine.config.sgd.optimizer == "adagrad"
         if path == "mxu":
-            if has_ex:
+            if has_ex and self._dym_mask is not None:
                 raise ValueError(
-                    "sparse_path='mxu' does not support extended (mf_ex) "
-                    "tables — use 'fast' or 'reference'")
+                    "sparse_path='mxu' with an extended (mf_ex) table does "
+                    "not compose with per-slot dynamic mf dims — drop "
+                    "slot_mf_dims or the expand embedding")
         elif path == "mxu_sharded":
             if has_ex:
                 raise ValueError(
                     "sparse_path='mxu_sharded' does not support extended "
-                    "(mf_ex) tables — use 'fast' or 'reference'")
+                    "(mf_ex) tables — use 'mxu' (single chip) which "
+                    "carries the ex columns through its kernels")
             if not self._mxu_shardable():
                 raise ValueError(
                     "sparse_path='mxu_sharded' needs a topology with a "
@@ -231,8 +243,10 @@ class SparseTrainer:
         emits only the trimmed width — auto mode times each on the live
         backend once per geometry."""
         from paddlebox_tpu.ops import crossing as cx
+        from paddlebox_tpu.ps.mxu_path import _ex_dim
         p = s * l * b
-        w = 3 + int(self.engine.ws["mf"].shape[1]) + 1
+        w = 3 + int(self.engine.ws["mf"].shape[1]) \
+            + _ex_dim(self.engine.ws) + 1
         backend = jax.default_backend()
         pull = cx.best_mode(p, p, w, backend)
         push = cx.best_mode(eff_p_pad or p, p, w, backend)
