@@ -170,13 +170,22 @@ class SparseTrainer:
                 # extended (mf_ex) tables ride the mxu kernels too — the
                 # ex columns join the feature-major table/payload
                 path = "mxu"
-            elif not has_ex and self._mxu_shardable():
+            elif self._mxu_shardable():
                 # explicit HeterComm-style exchange: row-sharded table,
                 # all_gather(ids) + per-device sorted-SpMM kernels +
                 # psum_scatter(values) inside shard_map
                 # (≙ heter_comm_inl.h:1296,1730 sharded pull/push in the
                 # hot loop)
                 path = "mxu_sharded"
+            elif has_ex:
+                # fast/reference pull only 3+D columns — an extended model
+                # would shape-error inside jit; demand an mxu-capable
+                # layout instead of falling through
+                raise ValueError(
+                    "extended (mf_ex) tables need the mxu or mxu_sharded "
+                    "path — this topology does not satisfy "
+                    "_mxu_shardable (pure dp×sharding mesh, divisible "
+                    "batch/table)")
             elif is_adagrad:
                 path = "fast"
             else:
@@ -213,11 +222,11 @@ class SparseTrainer:
                     "not compose with per-slot dynamic mf dims — drop "
                     "slot_mf_dims or the expand embedding")
         elif path == "mxu_sharded":
-            if has_ex:
+            if has_ex and self._dym_mask is not None:
                 raise ValueError(
-                    "sparse_path='mxu_sharded' does not support extended "
-                    "(mf_ex) tables — use 'mxu' (single chip) which "
-                    "carries the ex columns through its kernels")
+                    "sparse_path='mxu_sharded' with an extended (mf_ex) "
+                    "table does not compose with per-slot dynamic mf dims "
+                    "— drop slot_mf_dims or the expand embedding")
             if not self._mxu_shardable():
                 raise ValueError(
                     "sparse_path='mxu_sharded' needs a topology with a "
@@ -408,11 +417,15 @@ class SparseTrainer:
             def core(ws, params, opt_state, auc_state, idx_slb, lengths,
                      dense, labels, valid, plan, extras=None):
                 s, l, b = idx_slb.shape
-                d = ws["mf"].shape[1]
+                d_main = ws["mf"].shape[1]
+                dx = mxu_path._ex_dim(ws)
+                d = d_main + dx
                 n_rows = ws["show"].shape[0]
                 rows_loc = n_rows // n_tbl
                 idx_slb = jnp.where(jnp.arange(l)[None, :, None]
                                     < lengths[:, None, :], idx_slb, 0)
+                ex_args = (ws["mf_ex"],) if dx else ()
+                ex_specs = (tbl_spec2,) if dx else ()
 
                 if plan is not None:
                     # pass-resident per-device plans (build_pass_feed)
@@ -429,11 +442,13 @@ class SparseTrainer:
                         out_specs=plan_specs,
                         check_vma=False)(idx_slb)
 
-                def pull_local(show, click, embed_w, mf, mf_size, idx_loc,
-                               *pl):
+                def pull_local(show, click, embed_w, mf, mf_size,
+                               idx_loc, *rest):
+                    mf_ex = (rest[0].T,) if dx else ()
+                    pl = rest[1:] if dx else rest
                     tab = jnp.concatenate(
                         [show[None], click[None], embed_w[None], mf.T,
-                         mf_size.astype(jnp.float32)[None]], axis=0)
+                         *mf_ex, mf_size.astype(jnp.float32)[None]], axis=0)
                     # multinode: the node's replica serves its own batch
                     # shard — ids/values travel over ICI only
                     vals = se.pull_rows_sharded_mxu(
@@ -446,11 +461,11 @@ class SparseTrainer:
                     pull_local, mesh=mesh,
                     in_specs=(tbl_spec1, tbl_spec1, tbl_spec1, tbl_spec2,
                               tbl_spec1, P(None, None, batch_axes))
-                    + plan_specs,
+                    + ex_specs + plan_specs,
                     out_specs=P(None, None, batch_axes, None),
                     check_vma=False)(
                     ws["show"], ws["click"], ws["embed_w"], ws["mf"],
-                    ws["mf_size"], idx_slb, *splan)
+                    ws["mf_size"], idx_slb, *ex_args, *splan)
                 pooled = jax.lax.stop_gradient(
                     mxu_path.pool_cvm_values(v, use_cvm))
                 (params, opt_state, auc_state, loss, preds, d_pooled,
@@ -478,7 +493,7 @@ class SparseTrainer:
                               P(None, None, batch_axes, None)) + plan_specs,
                     out_specs=P(None, tbl_axes),
                     check_vma=False)(idx_slb, payload, *splan)  # [D+4, n_rows]
-                acc = mxu_path.acc_from_delta(delta, n_rows)
+                acc = mxu_path.acc_from_delta(delta, n_rows, d_main=d_main)
                 ws = sparse_opt.apply_push(ws, acc, sgd_cfg)
                 out = (ws, params, opt_state, auc_state, loss, preds)
                 return out + ((d_params,) if async_dense else ())

@@ -48,12 +48,13 @@ def _make_blocks(seed=0, n=192):
     return [blk]
 
 
-def _run(blocks, topo, sparse_path, packed=False, optimizer="adagrad"):
+def _run(blocks, topo, sparse_path, packed=False, optimizer="adagrad",
+         expand_dim=0):
     cfg = _feed_config()
     ds = SlotDataset(cfg)
     ds._blocks = blocks
     eng = BoxPSEngine(
-        EmbeddingTableConfig(embedding_dim=MF,
+        EmbeddingTableConfig(embedding_dim=MF, expand_dim=expand_dim,
                              sgd=SparseSGDConfig(
                                  optimizer=optimizer,
                                  mf_create_thresholds=0.0)),
@@ -63,8 +64,8 @@ def _run(blocks, topo, sparse_path, packed=False, optimizer="adagrad"):
         eng.add_keys(b.all_keys())
     eng.end_feed_pass()
     eng.begin_pass()
-    model = DeepFM(num_slots=N_SLOTS, emb_width=3 + MF, dense_dim=DENSE_DIM,
-                   hidden=(16,))
+    model = DeepFM(num_slots=N_SLOTS, emb_width=3 + MF + expand_dim,
+                   dense_dim=DENSE_DIM, hidden=(16,))
     tr = SparseTrainer(eng, model, cfg, batch_size=B, seed=0,
                        topology=topo, sparse_path=sparse_path)
     if packed:
@@ -93,8 +94,8 @@ def test_auto_resolves_to_mxu_sharded_on_pure_dp_mesh():
         eng.add_keys(b.all_keys())
     eng.end_feed_pass()
     eng.begin_pass()
-    model = DeepFM(num_slots=N_SLOTS, emb_width=3 + MF, dense_dim=DENSE_DIM,
-                   hidden=(16,))
+    model = DeepFM(num_slots=N_SLOTS, emb_width=3 + MF,
+                   dense_dim=DENSE_DIM, hidden=(16,))
     tr = SparseTrainer(eng, model, cfg, batch_size=B, topology=topo)
     assert tr._resolve_path() == "mxu_sharded"
 
@@ -175,3 +176,17 @@ def test_flat_pool_layout_matches_single_device():
         ("dp", "sharding", "mp", "sp", "ep"))
     assert np.isclose(s_ref["loss"], s_fl["loss"], atol=5e-4)
     _assert_ws_close(e_ref.ws, e_fl.ws)
+
+
+def test_extended_table_sharded_matches_single_device():
+    """Expand (mf_ex) tables ride the sharded exchange too: the ex columns
+    join the per-device feature-major table/payload and the push delta
+    splits back into g_embedx/g_embedx_ex (apply_push trains both)."""
+    blocks = _make_blocks(seed=13)
+    s_ref, e_ref, _ = _run(blocks, None, "mxu", expand_dim=3)
+    s_sh, e_sh, tr = _run(blocks, _topo8(), "auto", expand_dim=3)
+    assert tr._resolve_path() == "mxu_sharded"
+    assert np.isclose(s_ref["loss"], s_sh["loss"], atol=5e-4)
+    _assert_ws_close(e_ref.ws, e_sh.ws)
+    # the expand embedding trains (differs from its init) on both
+    assert not np.allclose(np.asarray(e_sh.ws["mf_ex"]), 0.0)
