@@ -147,3 +147,71 @@ def test_trimmed_dims_no_padding_degenerates():
     dims = sp.spmm_dims(256, 1000, chunk=8, tile=32)
     eff = sp.trimmed_dims(dims, 256)
     assert eff == dims
+
+
+def test_fuzz_random_geometries():
+    """Property fuzz: random (p, n_rows, chunk, tile, zero-fraction, skew)
+    geometries through plan build + gather + scatter, trimmed and not —
+    every result diffed against the dense reference."""
+    rng = np.random.default_rng(42)
+    for trial in range(12):
+        chunk = int(rng.choice([4, 8, 16]))
+        tile = int(rng.choice([16, 32, 64]))
+        p = int(rng.integers(1, 400))
+        n_rows = int(rng.integers(2, 1500))
+        zero_frac = float(rng.random()) * 0.6
+        if rng.random() < 0.3:   # heavy skew: few distinct rows
+            rows = rng.choice(
+                rng.integers(1, n_rows, size=max(1, n_rows // 50)), size=p)
+        else:
+            rows = rng.integers(0, n_rows, size=p)
+        rows = rows.astype(np.int32)
+        rows[rng.random(p) < zero_frac] = 0
+        dims = sp.spmm_dims(p, n_rows, chunk=chunk, tile=tile)
+        use_trim = rng.random() < 0.5
+        eff = sp.trimmed_dims(dims, int((rows != 0).sum())) if use_trim \
+            else None
+        kd = eff if (eff is not None and eff.p_pad < dims.p_pad) else dims
+        w = int(rng.integers(1, 9))
+        table = np.zeros((w, dims.n_kernel), np.float32)
+        # untrimmed trials exercise row 0 like any other row; trimmed
+        # trials require the reserved-zero-row convention
+        lo_row = 1 if kd is not dims else 0
+        table[:, lo_row:n_rows] = rng.normal(
+            0, 1, (w, n_rows - lo_row)).astype(np.float32)
+        payload = rng.normal(0, 1, (w, p)).astype(np.float32)
+        msg = f"trial={trial} p={p} n={n_rows} c={chunk} t={tile} " \
+              f"trim={kd is not dims}"
+
+        plan = sp.build_plan(jnp.asarray(rows), dims,
+                             eff if kd is not dims else None)
+        rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
+        g = sp.gather_sorted(jnp.asarray(table), rows2d, ch, tl, fg, kd,
+                             interpret=True)
+        if kd is dims:
+            v = np.asarray(g).T[:p][np.asarray(inv_perm)]
+        else:
+            iv = np.asarray(inv_perm)
+            v = np.asarray(g).T[np.maximum(iv, 0)] * (iv >= 0)[:, None]
+        np.testing.assert_allclose(v, table[:, rows].T, atol=1e-3,
+                                   rtol=1e-3, err_msg=msg)
+
+        if kd is dims:
+            srt = payload.T[np.asarray(perm)]
+            srt = np.concatenate(
+                [srt, np.zeros((dims.p_pad - p, w), np.float32)])
+        else:
+            p0 = dims.p_pad - kd.p_pad
+            perm_k = np.concatenate(
+                [np.asarray(perm), np.zeros(dims.p_pad - p, np.int64)])[p0:]
+            srt = payload.T[perm_k.astype(np.int64)]
+        d = sp.scatter_add_sorted(jnp.asarray(srt.T), rows2d, ch, tl, fs,
+                                  kd, interpret=True)
+        ref = np.zeros((w, dims.n_kernel), np.float32)
+        np.add.at(ref.T, rows, payload.T)
+        np.testing.assert_allclose(np.asarray(d)[:, lo_row:n_rows],
+                                   ref[:, lo_row:n_rows], atol=1e-2,
+                                   rtol=1e-3, err_msg=msg)
+        # untouched rows exactly zero — the optimizer masks depend on it
+        untouched = np.setdiff1d(np.arange(lo_row, n_rows), rows)
+        assert np.all(np.asarray(d)[:, untouched] == 0.0), msg
