@@ -124,3 +124,26 @@ class GraphTable:
     def sample_nodes(self, key: jax.Array, count: int) -> jnp.ndarray:
         """Uniform node draws (negative sampling, ≙ graph_node_sample)."""
         return jax.random.randint(key, (count,), 0, self.num_nodes)
+
+
+def sage_aggregate(emb: jnp.ndarray, neigh_idx: jnp.ndarray,
+                   reduce: str = "mean") -> jnp.ndarray:
+    """GraphSage neighbor aggregation (≙ the feature aggregation the
+    reference's GNN mode feeds from graph_neighbor_sample outputs).
+
+    emb [N, D] node-indexed features/embeddings; neigh_idx [B, K] sampled
+    neighbor ids, -1 where a node had no neighbor (sample_neighbors'
+    convention) → [B, D] mean/max over VALID neighbors (all-invalid rows
+    aggregate to zeros).  Pure jit-able gather + masked reduce.
+    """
+    if reduce not in ("mean", "max"):
+        raise ValueError(f"reduce must be mean|max, got {reduce!r}")
+    valid = neigh_idx >= 0                                  # [B, K]
+    rows = emb[jnp.maximum(neigh_idx, 0)]                   # [B, K, D]
+    m = valid[..., None].astype(emb.dtype)
+    if reduce == "mean":
+        cnt = jnp.maximum(valid.sum(axis=1, keepdims=True), 1)
+        return (rows * m).sum(axis=1) / cnt.astype(emb.dtype)
+    neg = jnp.where(valid[..., None], rows, -jnp.inf)
+    out = jnp.max(neg, axis=1)
+    return jnp.where(valid.any(axis=1, keepdims=True), out, 0.0)
