@@ -85,6 +85,13 @@ define_flag("pass_build_chunk", 500_000,
             "host->device pass-build chunk size (ps_gpu_wrapper.cc:757)")
 define_flag("tpu_batch_key_capacity", 0,
             "static per-batch key capacity; 0 = derive from data feed config")
+define_flag("sharded_exchange_bf16", False,
+            "move the mxu_sharded exchange's VALUE traffic (pull "
+            "psum_scatter + push payload all_gather) in bfloat16 — halves "
+            "ICI bytes at ~1e-2 relative error (EQuARX-style reduced-"
+            "precision collectives; ids/plans stay exact).  Read at step-BUILD "
+            "time: the packed loop retraces on a change, but a live "
+            "streaming step keeps its compiled value")
 define_flag("mxu_crossing", "auto",
             "sorted<->canonical crossing lowering for the mxu sparse path: "
             "take | sort | auto (auto = time both once per geometry on the "

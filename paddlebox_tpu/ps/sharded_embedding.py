@@ -134,7 +134,16 @@ def pull_rows_sharded_mxu(table_fm_local: jnp.ndarray,
     g = sp.gather_sorted(tab, rows2d, ch, tl, fg, dims,
                          interpret=interpret)                   # [W, p_pad]
     vals = jnp.take(g[:, :dims.p], inv_perm, axis=1)            # [W, P]
-    # requester receives its slice; only the owner contributed nonzero
+    # requester receives its slice; only the owner contributed nonzero.
+    # Optional reduced-precision collective (EQuARX-style): every element
+    # has exactly ONE nonzero contributor (the owning device), so the
+    # bf16 "sum" incurs only the rounding of that single value — ids and
+    # plans stay exact, ICI bytes halve.
+    from paddlebox_tpu import flags as _flags
+    if _flags.get_flags("sharded_exchange_bf16"):
+        return lax.psum_scatter(vals.astype(jnp.bfloat16), axis,
+                                scatter_dimension=1,
+                                tiled=True).astype(jnp.float32)
     return lax.psum_scatter(vals, axis, scatter_dimension=1, tiled=True)
 
 
@@ -159,7 +168,29 @@ def push_rows_sharded_mxu(idx_local: jnp.ndarray,
     else:
         dims = _plan_dims(plan, rows_loc)
     rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
-    pay_all = lax.all_gather(payload_local, axis, axis=1, tiled=True)
+    from paddlebox_tpu import flags as _flags
+    if _flags.get_flags("sharded_exchange_bf16"):
+        # halve the gathered payload bytes; the merge kernel's own hi/lo
+        # split then operates on the rounded values.  The slot column must
+        # stay EXACT (bf16 rounds integers > 256, and acc_from_delta
+        # rint()s it back to an id) — gather it separately in f32.
+        c = first_only_col
+        if c >= 0:
+            body = jnp.concatenate(
+                [payload_local[:c], payload_local[c + 1:]])
+            body_all = lax.all_gather(
+                body.astype(jnp.bfloat16), axis, axis=1,
+                tiled=True).astype(jnp.float32)
+            slot_all = lax.all_gather(payload_local[c:c + 1], axis,
+                                      axis=1, tiled=True)
+            pay_all = jnp.concatenate(
+                [body_all[:c], slot_all, body_all[c:]])
+        else:
+            pay_all = lax.all_gather(
+                payload_local.astype(jnp.bfloat16), axis, axis=1,
+                tiled=True).astype(jnp.float32)
+    else:
+        pay_all = lax.all_gather(payload_local, axis, axis=1, tiled=True)
     srt = jnp.take(pay_all, perm, axis=1)
     srt = jnp.concatenate(
         [srt, jnp.zeros((pay_all.shape[0], dims.p_pad - dims.p),

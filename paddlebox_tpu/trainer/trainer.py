@@ -709,6 +709,8 @@ class SparseTrainer:
         path = self._resolve_path()
         with_plans = feed.plans is not None
         n, s, l, b = feed.data["indices"].shape
+        exch_bf16 = (flags.get_flags("sharded_exchange_bf16")
+                     if path == "mxu_sharded" else False)
         crossing = ("take", "take")
         if path == "mxu":
             eff_p_pad = None
@@ -717,7 +719,7 @@ class SparseTrainer:
                 eff_p_pad = int(r[1]) * int(r[3])
             crossing = self._crossing_modes(s, l, b, eff_p_pad)
         return (path, with_plans, self.async_dense is not None, crossing,
-                self.engine.ws["show"].shape[0], (n, s, l, b))
+                exch_bf16, self.engine.ws["show"].shape[0], (n, s, l, b))
 
     def _build_packed_step(self, feed: PackedPassFeed):
         """Thin wrapper over the same per-path core as _build_step: slice

@@ -190,3 +190,27 @@ def test_extended_table_sharded_matches_single_device():
     _assert_ws_close(e_ref.ws, e_sh.ws)
     # the expand embedding trains (differs from its init) on both
     assert not np.allclose(np.asarray(e_sh.ws["mf_ex"]), 0.0)
+
+
+def test_bf16_exchange_close_to_exact():
+    """FLAGS_sharded_exchange_bf16 halves the exchange's ICI value bytes
+    (EQuARX-style reduced-precision collectives): loss must stay within
+    bf16 rounding of the exact run, and the slot column — gathered
+    separately in f32 — must stay id-exact."""
+    from paddlebox_tpu import flags
+
+    blocks = _make_blocks(seed=21)
+    s_exact, e_exact, _ = _run(blocks, _topo8(), "mxu_sharded")
+    old = flags.get_flags("sharded_exchange_bf16")
+    try:
+        flags.set_flags({"sharded_exchange_bf16": True})
+        s_q, e_q, tr = _run(blocks, _topo8(), "mxu_sharded")
+    finally:
+        flags.set_flags({"sharded_exchange_bf16": old})
+    assert np.isclose(s_exact["loss"], s_q["loss"], atol=2e-2), \
+        (s_exact["loss"], s_q["loss"])
+    # slot ids survive exactly despite the quantized payload body
+    a = np.asarray(e_exact.ws["slot"])
+    b = np.asarray(e_q.ws["slot"])
+    assert np.array_equal(a != 0, b != 0)
+    assert set(np.unique(b[b != 0])) <= set(range(100, 100 + N_SLOTS))
