@@ -291,15 +291,24 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     legacy = os.environ.get("BENCH_LEGACY_FEED") == "1"
     feed = None
     pack_s = 0.0
+    trim_frac = 1.0
     if not legacy:
         t0 = time.perf_counter()
         feed = trainer.build_pass_feed(dataset)
         jax.block_until_ready(feed.plans["perm"] if feed.plans is not None
                               else feed.data["indices"])
         pack_s = time.perf_counter() - t0
-        record(**{f"{tag}_pass_pack_s": round(pack_s, 1)})
+        if feed.plans is not None:
+            # kept fraction of the sorted domain after padding-trim
+            # (sorted_spmm.trimmed_dims) — the kernel/push-crossing work
+            # scales with this; plan_dims holds the untrimmed geometry
+            trim_frac = (feed.plans["rows2d"].shape[1]
+                         / feed.plan_dims.n_chunks)
+        record(**{f"{tag}_pass_pack_s": round(pack_s, 1),
+                  f"{tag}_trim_frac": round(trim_frac, 3)})
         trace(f"{tag}: pass feed built in {pack_s:.1f}s "
-              f"({feed.device_bytes() / 1e6:.0f} MB device-resident)")
+              f"({feed.device_bytes() / 1e6:.0f} MB device-resident, "
+              f"trim_frac={trim_frac:.3f})")
 
     set_phase(f"{tag}:compile", 600)
     ws, params = engine.ws, trainer.params
@@ -385,7 +394,7 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
             "batches": int(stats["batches"]), "examples": int(n_examples),
             "auc": round(float(stats.get("auc", float("nan"))), 4),
             "compile_s": round(compile_s, 1), "pass_pack_s": round(pack_s, 1),
-            "amp": amp, "step_ms": step_ms,
+            "amp": amp, "step_ms": step_ms, "trim_frac": round(trim_frac, 3),
             "timers": trainer.timers.report()}
 
 
@@ -428,7 +437,8 @@ def run() -> None:
          batches=full["batches"], examples=full["examples"],
          auc=full["auc"], backend=backend, pack_threads=PACK_THREADS,
          compile_s=full["compile_s"], pass_pack_s=full["pass_pack_s"],
-         amp=full["amp"], step_ms=full["step_ms"], timers=full["timers"])
+         amp=full["amp"], step_ms=full["step_ms"],
+         trim_frac=full["trim_frac"], timers=full["timers"])
 
 
 def main() -> None:
