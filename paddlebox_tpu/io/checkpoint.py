@@ -93,12 +93,19 @@ def save_xbox(engine: BoxPSEngine, path: str, base: bool = True) -> int:
     """Serving-model dump (≙ the "xbox" base/delta format written by
     SaveBase/SaveDelta, box_wrapper.cc:1286): one line per surviving
     feature — key \\t show \\t click \\t embed_w \\t mf...  Quantization of
-    embedx (quant_bits) applies here when configured."""
+    embedx (quant_bits) applies here when configured.
+
+    Row selection/masking is vectorized per shard and formatting runs in
+    the native TSV writer (native/dump_writer.cc, ≙ the reference's
+    native dump IO through PaddleFileMgr) with a per-row Python fallback.
+    """
+    from paddlebox_tpu.native import dump_writer
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     acc = engine.config.accessor
     qbits = engine.config.quant_bits
     n = 0
-    with open(path, "w") as f:
+    fh = None if dump_writer.available() else open(path, "w")
+    try:
         for shard in engine.table._shards:
             with shard.lock:
                 soa = shard.soa
@@ -106,21 +113,35 @@ def save_xbox(engine: BoxPSEngine, path: str, base: bool = True) -> int:
                 keep = (score >= acc.base_threshold) if base else \
                     (np.abs(soa["delta_score"]) >= acc.delta_threshold)
                 idx = np.nonzero(keep)[0]
-                for i in idx:
-                    # uncreated embedx serves zeros in training
-                    # (pull_sparse masks by mf_size) — dump the SAME
-                    # values or the serving side would see the random
-                    # candidate init (train/serve skew)
-                    mf = (soa["mf"][i] if soa["mf_size"][i] > 0
-                          else np.zeros_like(soa["mf"][i]))
-                    if qbits:
-                        scale = (1 << (qbits - 1)) - 1
-                        mf = np.round(mf * scale) / scale
-                    vals = " ".join(f"{v:.6g}" for v in mf)
-                    f.write(f"{shard.keys[i]}\t{soa['show'][i]:.6g}\t"
-                            f"{soa['click'][i]:.6g}\t"
-                            f"{soa['embed_w'][i]:.6g}\t{vals}\n")
-                    n += 1
+                if not len(idx):
+                    continue
+                keys = shard.keys[idx]
+                show = soa["show"][idx]
+                click = soa["click"][idx]
+                embed_w = soa["embed_w"][idx]
+                # uncreated embedx serves zeros in training (pull_sparse
+                # masks by mf_size) — dump the SAME values or the serving
+                # side would see the random candidate init
+                mf = np.where((soa["mf_size"][idx] > 0)[:, None],
+                              soa["mf"][idx], np.float32(0))
+                if qbits:
+                    scale = (1 << (qbits - 1)) - 1
+                    mf = np.round(mf * scale) / scale
+            if fh is None:
+                dump_writer.dump_rows(path, append=n > 0, keys=keys,
+                                      show=show, click=click,
+                                      embed_w=embed_w, mf=mf)
+            else:
+                for i in range(len(keys)):
+                    vals = " ".join(f"{v:.6g}" for v in mf[i])
+                    fh.write(f"{keys[i]}\t{show[i]:.6g}\t{click[i]:.6g}\t"
+                             f"{embed_w[i]:.6g}\t{vals}\n")
+            n += len(idx)
+        if fh is None and n == 0:
+            open(path, "w").close()     # empty dump still creates the file
+    finally:
+        if fh is not None:
+            fh.close()
     return n
 
 

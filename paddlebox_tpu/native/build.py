@@ -13,7 +13,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["slot_parser.cc", "hash_shard.cc"]
+_SOURCES = ["slot_parser.cc", "hash_shard.cc", "dump_writer.cc"]
 _LIB = os.path.join(_DIR, "_libpbox_native.so")
 _LOCK = threading.Lock()
 

@@ -1,0 +1,78 @@
+// Native xbox-dump TSV writer — the serving-dump IO hot path.
+//
+// ≙ the reference's native dump stack (SaveBase/SaveDelta write through
+// boxps::PaddleFileMgr + thread pools, box_wrapper.cc:1286): formatting
+// millions of "key\tshow\tclick\tembed_w\tmf..." lines in a Python loop
+// is ~100k rows/s; this C++ writer formats into a grow-only buffer and
+// writes once per call.  Loaded via ctypes (see io/checkpoint.py) with
+// graceful Python fallback.
+//
+// API (C ABI):
+//   pbox_dump_xbox(path, append, keys[n], show[n], click[n], embed_w[n],
+//                  mf[n*d], n, d) -> rows written, or -1 on IO error.
+//   show/click/embed_w are double so the ctr_double accessor's f64 stats
+//   format exactly like the Python fallback (f32 inputs convert exactly).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// %.6g-compatible float formatting (matches the Python writer's "%.6g")
+inline void append_g6(std::string &out, double v) {
+  char buf[32];
+  int k = snprintf(buf, sizeof(buf), "%.6g", v);
+  out.append(buf, k);
+}
+
+}  // namespace
+
+extern "C" {
+
+long long pbox_dump_xbox(const char *path, int append,
+                         const uint64_t *keys, const double *show,
+                         const double *click, const double *embed_w,
+                         const float *mf, long long n, long long d) {
+  FILE *f = fopen(path, append ? "ab" : "wb");
+  if (!f) return -1;
+  std::string buf;
+  buf.reserve(1 << 22);
+  char tmp[32];
+  for (long long i = 0; i < n; ++i) {
+    int k = snprintf(tmp, sizeof(tmp), "%llu",
+                     static_cast<unsigned long long>(keys[i]));
+    buf.append(tmp, k);
+    buf.push_back('\t');
+    append_g6(buf, show[i]);
+    buf.push_back('\t');
+    append_g6(buf, click[i]);
+    buf.push_back('\t');
+    append_g6(buf, embed_w[i]);
+    buf.push_back('\t');
+    const float *row = mf + i * d;
+    for (long long j = 0; j < d; ++j) {
+      if (j) buf.push_back(' ');
+      append_g6(buf, row[j]);
+    }
+    buf.push_back('\n');
+    if (buf.size() > (1u << 22)) {
+      if (fwrite(buf.data(), 1, buf.size(), f) != buf.size()) {
+        fclose(f);
+        return -1;
+      }
+      buf.clear();
+    }
+  }
+  if (!buf.empty() &&
+      fwrite(buf.data(), 1, buf.size(), f) != buf.size()) {
+    fclose(f);
+    return -1;
+  }
+  if (fclose(f) != 0) return -1;
+  return n;
+}
+
+}  // extern "C"

@@ -123,3 +123,21 @@ def test_load_xbox_base_plus_delta_last_wins(tmp_path):
     np.testing.assert_allclose(rows["show"], [5, 2])
     np.testing.assert_allclose(rows["embed_w"], [0.9, 0.3])
     np.testing.assert_allclose(rows["mf"], [[0.7, 0.8], [0.3, 0.4]])
+
+
+def test_native_dump_matches_python_fallback(data_file, tmp_path,
+                                             monkeypatch):
+    """The native TSV writer (dump_writer.cc) must produce byte-identical
+    output to the per-row Python fallback (%.6g parity)."""
+    from paddlebox_tpu.native import dump_writer
+
+    engine, trainer, _ = run_training(data_file, CtrDnn, passes=1)
+    p_native = str(tmp_path / "native.txt")
+    p_python = str(tmp_path / "python.txt")
+    if not dump_writer.available():
+        pytest.skip("native library unavailable")
+    n1 = save_xbox(engine, p_native, base=True)
+    monkeypatch.setattr(dump_writer, "available", lambda: False)
+    n2 = save_xbox(engine, p_python, base=True)
+    assert n1 == n2 > 0
+    assert open(p_native, "rb").read() == open(p_python, "rb").read()
