@@ -160,26 +160,38 @@ def load_xbox(engine: BoxPSEngine, path: str) -> np.ndarray:
         engine.end_feed_pass(); engine.begin_pass()
         engine.freeze_for_serving()
     """
+    from paddlebox_tpu.native import dump_writer
     d = engine.config.embedding_dim
-    keys, shows, clicks, ws_, mfs = [], [], [], [], []
-    with open(path) as f:
-        for line in f:
-            parts = line.rstrip("\n").split("\t")
-            if len(parts) != 5:
-                raise ValueError(f"malformed xbox line: {line[:80]!r}")
-            keys.append(int(parts[0]))
-            shows.append(float(parts[1]))
-            clicks.append(float(parts[2]))
-            ws_.append(float(parts[3]))
-            mf = (np.array(parts[4].split(), np.float32)
-                  if parts[4] else np.zeros((0,), np.float32))
-            if len(mf) != d:
-                raise ValueError(
-                    f"xbox row mf width {len(mf)} != table dim {d}")
-            mfs.append(mf)
+    native = dump_writer.load_rows(path, d)
+    if native is not None:
+        keys, shows, clicks, ws_, mf_mat = native
+    else:
+        keys, shows, clicks, ws_, mfs = [], [], [], [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if not line.strip():
+                    continue
+                if len(parts) != 5:
+                    raise ValueError(f"malformed xbox line: {line[:80]!r}")
+                keys.append(int(parts[0]))
+                shows.append(float(parts[1]))
+                clicks.append(float(parts[2]))
+                ws_.append(float(parts[3]))
+                mf = (np.array(parts[4].split(), np.float32)
+                      if parts[4] else np.zeros((0,), np.float32))
+                if len(mf) != d:
+                    raise ValueError(
+                        f"xbox row mf width {len(mf)} != table dim {d}")
+                mfs.append(mf)
+        mf_mat = (np.stack(mfs) if mfs
+                  else np.zeros((0, d), np.float32))
     keys = np.asarray(keys, np.uint64)
     if not len(keys):
         return keys
+    shows = np.asarray(shows, np.float32)
+    clicks = np.asarray(clicks, np.float32)
+    ws_ = np.asarray(ws_, np.float32)
     # dedupe LAST-wins: a concatenated base+delta file naturally repeats
     # keys, and the table's upsert contract requires unique keys per call
     # (host_table.py — duplicates would double-insert)
@@ -187,15 +199,13 @@ def load_xbox(engine: BoxPSEngine, path: str) -> np.ndarray:
     if len(last) != len(keys):
         sel = np.sort(last)
         keys = keys[sel]
-        shows = [shows[i] for i in sel]
-        clicks = [clicks[i] for i in sel]
-        ws_ = [ws_[i] for i in sel]
-        mfs = [mfs[i] for i in sel]
+        shows, clicks, ws_ = shows[sel], clicks[sel], ws_[sel]
+        mf_mat = mf_mat[sel]
     rows = engine.table.bulk_pull(keys)     # schema defaults
-    rows["show"] = np.asarray(shows, np.float32)
-    rows["click"] = np.asarray(clicks, np.float32)
-    rows["embed_w"] = np.asarray(ws_, np.float32)
-    rows["mf"] = np.stack(mfs)
+    rows["show"] = shows
+    rows["click"] = clicks
+    rows["embed_w"] = ws_
+    rows["mf"] = np.asarray(mf_mat, np.float32)
     # the dump writes zeros for uncreated embedx (see save_xbox) — derive
     # mf_size so serving pulls mask exactly like training did
     created = np.any(rows["mf"] != 0.0, axis=1)

@@ -16,6 +16,9 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <cstdlib>
+#include <cctype>
+#include <cerrno>
 #include <string>
 #include <vector>
 
@@ -73,6 +76,73 @@ long long pbox_dump_xbox(const char *path, int append,
   }
   if (fclose(f) != 0) return -1;
   return n;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Parse an xbox dump buffer into preallocated column arrays.
+// buf[len] is the whole file (NUL-terminated by the caller); rows were
+// counted host-side (one per newline-terminated, non-empty line).
+// Returns rows parsed, or -(line_index+1) on a malformed line (wrong
+// field/mf count, bad or out-of-range number) so the caller can report
+// the exact line.  The strto* family skips leading whitespace INCLUDING
+// newlines — every field start is checked against that (a truncated
+// line must fail loud, never silently consume the next line), and every
+// parse end is bounds-checked against the line.
+long long pbox_load_xbox(const char *buf, long long len, uint64_t *keys,
+                         double *show, double *click, double *embed_w,
+                         float *mf, long long n_rows, long long d) {
+  const char *p = buf;
+  const char *end = buf + len;
+  long long row = 0;
+  while (p < end && row < n_rows) {
+    const char *line_end = static_cast<const char *>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (!line_end) line_end = end;
+    if (line_end == p) {  // empty line
+      p = line_end + 1;
+      continue;
+    }
+    char *cur = const_cast<char *>(p);
+    char *nxt = nullptr;
+    const char *le = line_end;
+    auto field_ok = [&](char *c) {
+      return c < le && !isspace(static_cast<unsigned char>(*c));
+    };
+    if (!field_ok(cur)) return -(row + 1);
+    errno = 0;
+    keys[row] = strtoull(cur, &nxt, 10);
+    if (nxt == cur || nxt > le || errno == ERANGE || *nxt != '\t')
+      return -(row + 1);
+    cur = nxt + 1;
+    double *cols[3] = {show, click, embed_w};
+    for (int c3 = 0; c3 < 3; ++c3) {
+      if (!field_ok(cur)) return -(row + 1);
+      errno = 0;
+      cols[c3][row] = strtod(cur, &nxt);
+      if (nxt == cur || nxt > le || errno == ERANGE || *nxt != '\t')
+        return -(row + 1);
+      cur = nxt + 1;
+    }
+    float *out = mf + row * d;
+    for (long long j = 0; j < d; ++j) {
+      if (!field_ok(cur)) return -(row + 1);
+      errno = 0;
+      out[j] = strtof(cur, &nxt);
+      if (nxt == cur || nxt > le || errno == ERANGE) return -(row + 1);
+      cur = nxt;
+      if (j + 1 < d) {
+        if (*cur != ' ') return -(row + 1);
+        ++cur;
+      }
+    }
+    if (cur < line_end && *cur != '\r') return -(row + 1);
+    p = line_end + 1;
+    ++row;
+  }
+  return row;
 }
 
 }  // extern "C"

@@ -36,6 +36,12 @@ def _load():
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_longlong, ctypes.c_longlong]
+            lib.pbox_load_xbox.restype = ctypes.c_longlong
+            lib.pbox_load_xbox.argtypes = [
+                ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_longlong, ctypes.c_longlong]
         except (OSError, AttributeError):
             # a stale prebuilt .so without this symbol must degrade to
             # the Python fallback, not crash the one caller that has one
@@ -73,3 +79,39 @@ def dump_rows(path: str, append: bool, keys: np.ndarray, show: np.ndarray,
     if wrote < 0:
         raise OSError(f"native xbox dump failed writing {path!r}")
     return int(wrote)
+
+
+def load_rows(path: str, d: int):
+    """Parse a whole xbox dump natively → (keys, show, click, embed_w, mf)
+    arrays, or None when the native library is unavailable.  Raises
+    ValueError naming the malformed line index on bad input."""
+    import os
+    lib = _load()
+    if lib is None:
+        return None
+    size = os.path.getsize(path)
+    buf = bytearray(size + 1)     # one allocation, NUL-terminated in place
+    with open(path, "rb") as f:
+        got = f.readinto(memoryview(buf)[:size])
+    if got != size:
+        raise OSError(f"short read loading {path!r}")
+    buf[size] = 0
+    upper = buf.count(b"\n", 0, size) + (
+        0 if size == 0 or buf[size - 1] == 0x0A else 1)
+    upper = max(upper, 1)
+    keys = np.empty((upper,), np.uint64)
+    show = np.empty((upper,), np.float64)
+    click = np.empty((upper,), np.float64)
+    embed_w = np.empty((upper,), np.float64)
+    mf = np.empty((upper, max(d, 1)), np.float32)
+    cbuf = (ctypes.c_char * len(buf)).from_buffer(buf)
+    ret = lib.pbox_load_xbox(cbuf, size, keys.ctypes.data,
+                             show.ctypes.data, click.ctypes.data,
+                             embed_w.ctypes.data, mf.ctypes.data,
+                             upper, d)
+    if ret < 0:
+        raise ValueError(
+            f"malformed xbox line {-int(ret)} in {path!r} "
+            f"(expected key\\tshow\\tclick\\tembed_w\\t{d} mf values)")
+    n = int(ret)
+    return (keys[:n], show[:n], click[:n], embed_w[:n], mf[:n])

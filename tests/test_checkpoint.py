@@ -141,3 +141,48 @@ def test_native_dump_matches_python_fallback(data_file, tmp_path,
     n2 = save_xbox(engine, p_python, base=True)
     assert n1 == n2 > 0
     assert open(p_native, "rb").read() == open(p_python, "rb").read()
+
+
+def test_native_load_matches_python_fallback(data_file, tmp_path,
+                                             monkeypatch):
+    """Native xbox reader (pbox_load_xbox) vs the per-line Python parse:
+    identical table contents, and a malformed line fails loud with its
+    index."""
+    from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+    from paddlebox_tpu.io.checkpoint import load_xbox
+    from paddlebox_tpu.native import dump_writer
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+
+    if not dump_writer.available():
+        pytest.skip("native library unavailable")
+    engine, trainer, _ = run_training(data_file, CtrDnn, passes=1)
+    path = str(tmp_path / "x.txt")
+    n = save_xbox(engine, path, base=True)
+    assert n > 0
+
+    def fresh():
+        return BoxPSEngine(EmbeddingTableConfig(
+            embedding_dim=engine.config.embedding_dim, shard_num=4,
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+
+    # a malformed line fails loud with its index (native parser)
+    bad = str(tmp_path / "bad.txt")
+    with open(bad, "w") as f:
+        f.write("7\t1\t0\t0.5\t0.1 0.2\n")
+        f.write("9\tnot_a_number\t1\t0.3\t0.3 0.4\n")
+    with pytest.raises(ValueError, match="malformed xbox line 2"):
+        load_xbox(BoxPSEngine(EmbeddingTableConfig(
+            embedding_dim=2, shard_num=2,
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0))), bad)
+
+    e_native = fresh()
+    k1 = load_xbox(e_native, path)
+    e_py = fresh()
+    monkeypatch.setattr(dump_writer, "load_rows", lambda *a: None)
+    k2 = load_xbox(e_py, path)
+    assert np.array_equal(np.sort(k1), np.sort(k2))
+    probe = k1[:16]
+    a = e_native.table.bulk_pull(probe)
+    b = e_py.table.bulk_pull(probe)
+    for fld in ("show", "click", "embed_w", "mf", "mf_size"):
+        np.testing.assert_array_equal(a[fld], b[fld], err_msg=fld)
