@@ -10,7 +10,7 @@ become paddlebox_tpu.flags.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Tuple
 
 
 @dataclasses.dataclass(frozen=True)

@@ -18,8 +18,7 @@ This module offers the same verbs over the TPU engine/trainer.
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 

@@ -17,7 +17,7 @@ downstream).
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Optional, Tuple
+from typing import Optional
 
 import numpy as np
 import jax

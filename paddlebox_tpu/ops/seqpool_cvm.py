@@ -29,8 +29,6 @@ columns carry the *instance* show/click so pushes accumulate counts
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
-
 import numpy as np
 import jax
 import jax.numpy as jnp

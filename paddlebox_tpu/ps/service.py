@@ -24,7 +24,6 @@ from typing import Dict, Optional, Tuple, Union
 
 import numpy as np
 
-from paddlebox_tpu.config import EmbeddingTableConfig
 from paddlebox_tpu.ps import wire
 from paddlebox_tpu.ps.host_table import ShardedHostTable
 

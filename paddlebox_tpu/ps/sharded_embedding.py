@@ -22,8 +22,6 @@ path (embedding.py + HybridTopology.table_spec) remains the default.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
-
 import jax
 import jax.numpy as jnp
 from jax import lax
