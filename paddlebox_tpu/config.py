@@ -49,6 +49,14 @@ class DataFeedConfig:
     # data/rank_offset.py — requires logkey-parsed cmatch/rank fields)
     rank_offset: bool = False
     max_rank: int = 3               # hardcoded 3 in the reference (:1858)
+    # ≙ MultiSlotDesc.uid_slot: the sparse slot whose FIRST feasign is the
+    # instance's user id — feeds the per-user WuAUC metrics (host-side
+    # accumulation; opting in adds one preds D2H per batch, exactly the
+    # reference's SyncCopyD2H in add_uid_data, metrics.cc:440)
+    uid_slot: str = ""
+    # ≙ DataFeedDesc.sample_rate: keep each instance with this probability
+    # at load time (feed-level downsampling)
+    sample_rate: float = 1.0
 
     def __post_init__(self):
         object.__setattr__(self, "slots", tuple(self.slots))
@@ -58,6 +66,13 @@ class DataFeedConfig:
             raise ValueError(
                 f"string slots {dense_str} cannot be is_dense — they are "
                 "aux index planes (InputTable), not dense features")
+        if not (0.0 < self.sample_rate <= 1.0):
+            raise ValueError(
+                f"sample_rate must be in (0, 1], got {self.sample_rate}")
+        if self.uid_slot and self.uid_slot not in {
+                s.name for s in self.sparse_slots}:
+            raise ValueError(
+                f"uid_slot {self.uid_slot!r} is not a sparse slot")
         reserved = {"indices", "lengths", "dense", "labels", "valid",
                     "rank_offset"}
         bad = [s.name for s in self.string_slots if s.name in reserved]

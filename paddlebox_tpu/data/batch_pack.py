@@ -39,6 +39,7 @@ class PackedBatch:
     rank_offset: Optional[np.ndarray] = None  # [B, 1+2*max_rank] int32 (pv)
     # InputTable-resolved aux index planes [B, cap] int32 per string slot
     aux: Optional[dict] = None
+    uid: Optional[np.ndarray] = None    # [B] uint64 (uid_slot, host-side)
 
 
 class BatchPacker:
@@ -130,6 +131,14 @@ class BatchPacker:
                                          block.rank, B,
                                          self.config.max_rank)
 
+        uid = None
+        if self.config.uid_slot:
+            # first feasign of the uid slot = the instance's user id
+            # (≙ MultiSlotDesc.uid_slot feeding WuAucMetricMsg)
+            vals, offs = block.uint64_slots[self.config.uid_slot]
+            uid = np.zeros((B,), np.uint64)
+            uid[:n] = self._pad_ragged(vals, offs, 1)[0][:, 0]
+
         aux = None
         if self.config.string_slots:
             # InputTable index planes (≙ InputTableDataFeed feed vars,
@@ -145,4 +154,4 @@ class BatchPacker:
         return PackedBatch(indices=indices, lengths=lengths, dense=dense,
                            labels=labels, valid=valid, num_real=n, keys=keys,
                            ins_ids=block.ins_ids, rank_offset=rank_off,
-                           aux=aux)
+                           aux=aux, uid=uid)

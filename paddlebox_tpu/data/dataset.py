@@ -130,11 +130,26 @@ class SlotDataset:
         blocks: List[SlotRecordBlock] = []
         lock = threading.Lock()
 
+        rate = self.feed_config.sample_rate
+
         def read_one(path: str) -> None:
             feed = DataFeed(self.feed_config, self.parse_ins_id,
                             self.parse_logkey,
                             input_table=self.input_table)
+            # per-file rng seeded by (rand_seed, path): the kept instance
+            # SET is deterministic regardless of reader-thread interleaving
+            import zlib
+            rng_f = np.random.default_rng(
+                [self.feed_config.rand_seed or 0,
+                 zlib.crc32(path.encode())])
             for block in feed.read_file(path):
+                if rate < 1.0:
+                    # feed-level instance downsampling
+                    # (≙ DataFeedDesc.sample_rate)
+                    keep = np.nonzero(rng_f.random(block.n) < rate)[0]
+                    block = block.select(keep)
+                    if block.n == 0:
+                        continue
                 for consumer in self._key_consumers:
                     consumer(block.all_keys())
                 with lock:

@@ -59,6 +59,8 @@ class HostPassArrays:
     rank_offset: Optional[np.ndarray] = None  # [N*B, 1+2*max_rank] int32
     # InputTable-resolved aux index planes {name: [N*B, cap] int32}
     aux: Optional[Dict[str, np.ndarray]] = None
+    uid: Optional[np.ndarray] = None    # [N*B] uint64 (uid_slot, HOST-side:
+    #   uids never ship to device — wuauc accumulates on host)
 
     def extra_planes(self) -> Dict[str, np.ndarray]:
         """Every optional per-record plane (rank_offset + aux index
@@ -177,6 +179,12 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
     valid = np.zeros((nb,), dtype=bool)
     valid[pos] = True
 
+    uid = None
+    if feed_config.uid_slot:
+        vals, offs = merged.uint64_slots[feed_config.uid_slot]
+        uid = np.zeros((nb,), np.uint64)
+        uid[pos] = packer._pad_ragged(vals, offs, 1)[0][:, 0]
+
     aux = None
     if feed_config.string_slots:
         # InputTable index planes (≙ InputTableDataFeed, data_feed.h:2224)
@@ -192,7 +200,7 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
                          labels=labels, valid=valid, n_batches=n_batches,
                          batch_size=batch_size, num_real=n,
                          ins_ids=merged.ins_ids, batch_real=batch_real,
-                         batch_base=batch_base, aux=aux)
+                         batch_base=batch_base, aux=aux, uid=uid)
     if feed_config.rank_offset:
         # ≙ GetRankOffset per batch (data_feed.cc:1855) — batch-local row
         # indices; meaningful under pv grouping (whole pvs per batch)
@@ -232,6 +240,9 @@ class PackedPassFeed:
     plans: Optional[Dict[str, jnp.ndarray]] = None
     plan_dims: object = None                # SpmmDims the plans were built for
     host: Optional[HostPassArrays] = None   # kept for dump/ins_ids paths
+    uid: Optional[np.ndarray] = None        # [N*B] uint64 host-side uids
+    host_labels: Optional[np.ndarray] = None  # [N*B(,T)] (uid_slot only)
+    host_valid: Optional[np.ndarray] = None   # [N*B] bool (uid_slot only)
 
     def device_bytes(self) -> int:
         tot = sum(int(np.prod(a.shape)) * a.dtype.itemsize
@@ -325,7 +336,9 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
                 for k, v in data.items()}
     return PackedPassFeed(data=data, n_batches=N, batch_size=B,
                           num_real=h.num_real,
-                          host=h if keep_host else None)
+                          host=h if keep_host else None, uid=h.uid,
+                          host_labels=h.labels if h.uid is not None else None,
+                          host_valid=h.valid if h.uid is not None else None)
 
 
 def precompute_plans(feed: PackedPassFeed, dims, eff=None) -> None:

@@ -31,8 +31,8 @@ from paddlebox_tpu.data.batch_pack import BatchPacker, PackedBatch
 from paddlebox_tpu.data.dataset import SlotDataset
 from paddlebox_tpu.data.pass_feed import (PackedPassFeed, plan_tuple,
                                           slice_batch)
-from paddlebox_tpu.metrics.auc import (AucCalculator, accumulate_auc,
-                                       make_auc_state)
+from paddlebox_tpu.metrics.auc import (AucCalculator, WuAucCalculator,
+                                       accumulate_auc, make_auc_state)
 from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
 from paddlebox_tpu.parallel.topology import HybridTopology
 from paddlebox_tpu.ps import embedding, optimizer as sparse_opt
@@ -124,6 +124,10 @@ class SparseTrainer:
         self.auc_table_size = auc_table_size
         self.auc_state = make_auc_state(auc_table_size)
         self.auc = AucCalculator(auc_table_size)
+        # per-user metrics (≙ WuAucMetricMsg via MultiSlotDesc.uid_slot):
+        # host-side records — opting in syncs preds per batch, exactly the
+        # reference's add_uid_data D2H (metrics.cc:440)
+        self.wuauc = (WuAucCalculator() if feed_config.uid_slot else None)
         self._step_fn = None
         self._packed_step_fn = None
         self._packed_sig = None
@@ -777,6 +781,11 @@ class SparseTrainer:
         if self._packed_step_fn is None \
                 or self._packed_sig != self._packed_signature(feed):
             self._build_packed_step(feed)
+        if self.wuauc is not None and (feed.uid is None
+                                       or feed.host_labels is None):
+            raise ValueError(
+                "uid_slot is configured but this feed carries no host "
+                "uids/labels — build it with build_pass_feed")
         engine = self.engine
         ws, params = engine.ws, self.params
         opt_state, auc_state = self.opt_state, self.auc_state
@@ -821,6 +830,14 @@ class SparseTrainer:
                         for j in range(cnt):
                             dump_file.write(
                                 f"{ids[j]}\t{lbl[j]:g}\t{p[j]:.6f}\n")
+                if self.wuauc is not None:
+                    sl = slice(i * feed.batch_size,
+                               (i + 1) * feed.batch_size)
+                    lbl = feed.host_labels[sl]
+                    if lbl.ndim > 1:
+                        lbl = lbl[:, 0]
+                    self.wuauc.add_data(np.asarray(preds), lbl,
+                                        feed.uid[sl], feed.host_valid[sl])
                 losses.append(loss)
                 n_batches += 1
                 if progress is not None:
@@ -973,6 +990,11 @@ class SparseTrainer:
                     ids = batch.ins_ids or [""] * batch.num_real
                     for i in range(batch.num_real):
                         dump_file.write(f"{ids[i]}\t{lbl[i]:g}\t{p[i]:.6f}\n")
+                if self.wuauc is not None:
+                    lblh = (batch.labels if batch.labels.ndim == 1
+                            else batch.labels[:, 0])
+                    self.wuauc.add_data(np.asarray(preds), lblh,
+                                        batch.uid, batch.valid)
                 losses.append(loss)
                 n_batches += 1
                 if progress is not None:
@@ -1003,11 +1025,22 @@ class SparseTrainer:
     def _finalize_metrics(self, auc_state) -> Dict[str, float]:
         self.auc.reset()
         self.auc.merge_device_state(jax.device_get(auc_state))
-        return self.auc.compute()
+        out = self.auc.compute()
+        if self.wuauc is not None:
+            w = self.wuauc.compute()
+            out["uauc"] = w["uauc"]
+            out["wuauc"] = w["wuauc"]
+            out["wuauc_users"] = w["user_cnt"]
+            # per-pass metric: drop the raw records (≙ reset_records) —
+            # unlike the O(table_size) AUC buckets they grow per record
+            self.wuauc.reset()
+        return out
 
     def reset_metrics(self):
         self.auc_state = make_auc_state(self.auc_table_size)
         self.auc.reset()
+        if self.wuauc is not None:
+            self.wuauc.reset()
 
 
 @lru_cache(maxsize=None)
