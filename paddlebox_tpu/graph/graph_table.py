@@ -147,3 +147,40 @@ def sage_aggregate(emb: jnp.ndarray, neigh_idx: jnp.ndarray,
     neg = jnp.where(valid[..., None], rows, -jnp.inf)
     out = jnp.max(neg, axis=1)
     return jnp.where(valid.any(axis=1, keepdims=True), out, 0.0)
+
+
+def metapath_walk(tables, starts: jnp.ndarray, length: int,
+                  key: jax.Array) -> jnp.ndarray:
+    """Meta-path walks over typed edge tables (≙ GraphConfig.meta_path +
+    first_node_type, data_feed.proto:29-40: e.g. "user2item-item2user"
+    walks alternate edge types so each hop lands on the path's next node
+    type).  tables: one GraphTable per meta-path edge type, applied
+    cyclically; starts [B] nodes of the first type → [B, length+1] walk.
+
+    A walk that dead-ends STAYS stuck (repeating its node) — id spaces of
+    different node types may overlap across tables, so re-sampling a
+    stuck node in a later edge type could silently resume through an
+    unrelated entity of the wrong type.  One lax.scan program (like
+    random_walk), with lax.switch selecting the hop's edge table."""
+    if not tables:
+        raise ValueError("metapath_walk needs at least one edge table")
+    cur = jnp.asarray(starts, jnp.int32)
+    k = len(tables)
+    keys = jax.random.split(key, length)
+
+    def step(carry, inp):
+        node, stuck = carry
+        t_idx, subkey = inp
+        branches = [
+            (lambda sk, nd, t=t: t.sample_neighbors(
+                jnp.maximum(nd, 0), 1, sk)[:, 0]) for t in tables]
+        nxt_raw = jax.lax.switch(t_idx, branches, subkey, node)
+        ok = (nxt_raw >= 0) & ~stuck
+        nxt = jnp.where(ok, nxt_raw, node)
+        stuck = stuck | (nxt_raw < 0)
+        return (nxt, stuck), nxt
+
+    t_ids = jnp.arange(length, dtype=jnp.int32) % k
+    (_, _), path = jax.lax.scan(
+        step, (cur, jnp.zeros_like(cur, bool)), (t_ids, keys))
+    return jnp.concatenate([cur[:, None], path.T], axis=1)

@@ -136,3 +136,52 @@ def test_sage_aggregate_learns_node_classification():
     import pytest as _pytest
     with _pytest.raises(ValueError, match="mean|max"):
         sage_aggregate(emb, bad, "sum")
+
+
+def test_metapath_walk_alternates_types():
+    """Bipartite user→item / item→user edge tables: a meta-path walk must
+    alternate node types every hop (≙ GraphConfig.meta_path semantics)."""
+    from paddlebox_tpu.graph.graph_table import metapath_walk
+
+    rng = np.random.default_rng(2)
+    n_u, n_i = 20, 30
+    # users 1..20, items 21..50
+    u2i = []
+    for u in range(1, n_u + 1):
+        for it in rng.choice(np.arange(n_u + 1, n_u + n_i + 1), 4,
+                             replace=False):
+            u2i.append((u, it))
+    i2u = [(b, a) for a, b in u2i]
+    n_all = n_u + n_i + 1
+    t_u2i = GraphTable(np.asarray(u2i, np.int64), num_nodes=n_all)
+    t_i2u = GraphTable(np.asarray(i2u, np.int64), num_nodes=n_all)
+
+    starts = jnp.arange(1, n_u + 1, dtype=jnp.int32)
+    walks = np.asarray(metapath_walk([t_u2i, t_i2u], starts, 6,
+                                     jax.random.PRNGKey(0)))
+    assert walks.shape == (n_u, 7)
+    is_item = walks > n_u
+    # hops 0,2,4,6 are users; 1,3,5 are items
+    assert not is_item[:, 0::2].any()
+    assert is_item[:, 1::2].all()
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="edge table"):
+        metapath_walk([], starts, 3, jax.random.PRNGKey(0))
+
+
+def test_metapath_stuck_walk_stays_stuck():
+    """A dead-ended walk must repeat its node forever — id spaces of
+    different node types may collide, so re-sampling a stuck node through
+    the OTHER edge table could resume through an unrelated entity."""
+    from paddlebox_tpu.graph.graph_table import metapath_walk
+
+    # user 1 has no u2i edge; item table REUSES id 1 with an edge — the
+    # stuck user-walk must NOT pick it up
+    t_u2i = GraphTable(np.asarray([(2, 5)], np.int64), num_nodes=8)
+    t_i2u = GraphTable(np.asarray([(1, 7), (5, 2)], np.int64), num_nodes=8)
+    walks = np.asarray(metapath_walk(
+        [t_u2i, t_i2u], jnp.asarray([1, 2], jnp.int32), 4,
+        jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(walks[0], [1, 1, 1, 1, 1])   # stuck
+    np.testing.assert_array_equal(walks[1], [2, 5, 2, 5, 2])   # cycles
