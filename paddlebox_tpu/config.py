@@ -49,6 +49,11 @@ class DataFeedConfig:
     # data/rank_offset.py — requires logkey-parsed cmatch/rank fields)
     rank_offset: bool = False
     max_rank: int = 3               # hardcoded 3 in the reference (:1858)
+    # ≙ DataFeedDesc.ads_offset (data_feed.cc:3092 + GetAdsOffset:
+    # the [pv_num+1] prefix offsets of each page view's ads within the
+    # batch) — emitted as a static [B+1] extras plane (tail repeats the
+    # real-instance count); requires pv-grouped batches like rank_offset
+    ads_offset: bool = False
     # ≙ MultiSlotDesc.uid_slot: the sparse slot whose FIRST feasign is the
     # instance's user id — feeds the per-user WuAUC metrics (host-side
     # accumulation; opting in adds one preds D2H per batch, exactly the
@@ -74,7 +79,7 @@ class DataFeedConfig:
             raise ValueError(
                 f"uid_slot {self.uid_slot!r} is not a sparse slot")
         reserved = {"indices", "lengths", "dense", "labels", "valid",
-                    "rank_offset"}
+                    "rank_offset", "ads_offset"}
         bad = [s.name for s in self.string_slots if s.name in reserved]
         if bad:
             raise ValueError(

@@ -40,6 +40,7 @@ class PackedBatch:
     # InputTable-resolved aux index planes [B, cap] int32 per string slot
     aux: Optional[dict] = None
     uid: Optional[np.ndarray] = None    # [B] uint64 (uid_slot, host-side)
+    ads_offset: Optional[np.ndarray] = None   # [B+1] int32 pv offsets
 
 
 class BatchPacker:
@@ -131,6 +132,11 @@ class BatchPacker:
                                          block.rank, B,
                                          self.config.max_rank)
 
+        ads_off = None
+        if self.config.ads_offset:
+            from paddlebox_tpu.data.rank_offset import build_ads_offset
+            ads_off = build_ads_offset(block.search_ids, n, B)
+
         uid = None
         if self.config.uid_slot:
             # first feasign of the uid slot = the instance's user id
@@ -154,4 +160,4 @@ class BatchPacker:
         return PackedBatch(indices=indices, lengths=lengths, dense=dense,
                            labels=labels, valid=valid, num_real=n, keys=keys,
                            ins_ids=block.ins_ids, rank_offset=rank_off,
-                           aux=aux, uid=uid)
+                           aux=aux, uid=uid, ads_offset=ads_off)

@@ -57,6 +57,7 @@ class HostPassArrays:
     batch_real: Optional[np.ndarray] = None   # [N] int64
     batch_base: Optional[np.ndarray] = None   # [N] int64
     rank_offset: Optional[np.ndarray] = None  # [N*B, 1+2*max_rank] int32
+    ads_offset: Optional[np.ndarray] = None   # [N, B+1] int32 pv offsets
     # InputTable-resolved aux index planes {name: [N*B, cap] int32}
     aux: Optional[Dict[str, np.ndarray]] = None
     uid: Optional[np.ndarray] = None    # [N*B] uint64 (uid_slot, HOST-side:
@@ -109,14 +110,15 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
         counts = [b.n for b in blocks]
     else:
         counts = None
-    if feed_config.rank_offset and counts is None:
+    if ((feed_config.rank_offset or feed_config.ads_offset)
+            and counts is None):
         # the plane builder treats each batch slice as whole page views; a
         # pv split across dense cuts would silently attend over fragment
         # peers — every entry point inherits this guard, not just the
         # trainer (≙ GetRankOffset only runs under pv merge,
         # data_feed.cc:1855)
         raise ValueError(
-            "rank_offset=True requires pv-aligned batches: pass "
+            "rank_offset/ads_offset require pv-aligned batches: pass "
             "prebatched blocks or batch_counts (dataset.batch_bounds)")
     if counts is not None:
         over = [c for c in counts if c > batch_size]
@@ -217,6 +219,15 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
                 None if merged.cmatch is None else merged.cmatch[sl],
                 None if merged.rank is None else merged.rank[sl],
                 batch_size, feed_config.max_rank)
+    if feed_config.ads_offset:
+        # ≙ GetAdsOffset per batch (data_feed.cc:3592): pv prefix offsets
+        from paddlebox_tpu.data.rank_offset import build_ads_offset
+        out.ads_offset = np.zeros((n_batches, batch_size + 1), np.int32)
+        for i in range(n_batches):
+            lo, cnt, base = out.real_range(i)
+            sid = (None if merged.search_ids is None
+                   else merged.search_ids[base:base + cnt])
+            out.ads_offset[i] = build_ads_offset(sid, cnt, batch_size)
     return out
 
 
@@ -270,6 +281,8 @@ def _relayout(d, N: int, B: int):
     }
     lbl = d["labels"]
     out["labels"] = lbl.reshape((N, B) + lbl.shape[1:])
+    if "ads_offset" in d:                   # per-BATCH plane [N, B+1]
+        out["ads_offset"] = d["ads_offset"]
     for k in d:   # extra per-record planes ([N*B, w] -> [N, B, w])
         if k not in out and k != "labels":
             out[k] = d[k].reshape(N, B, -1)
@@ -330,6 +343,16 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
     }
     for k, v in h.extra_planes().items():
         dev[k] = put(k, v)
+    if h.ads_offset is not None:
+        # tiny per-batch plane, replicated over the mesh (a plain
+        # process-local array cannot mix with global arrays under jit)
+        if sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            mesh0 = next(iter(sharding.values())).mesh
+            dev["ads_offset"] = jax.device_put(
+                h.ads_offset, NamedSharding(mesh0, P()))
+        else:
+            dev["ads_offset"] = jnp.asarray(h.ads_offset)
     data = _relayout(dev, N, B)
     if sharding is not None:
         data = {k: jax.device_put(v, sharding[k]) if k in sharding else v

@@ -73,3 +73,26 @@ def build_rank_offset(search_ids: Optional[np.ndarray],
     out[rows[:, None], 2 + 2 * np.arange(max_rank)[None]] = peers.astype(
         np.int32)
     return out
+
+
+def build_ads_offset(search_ids: Optional[np.ndarray], n_real: int,
+                     batch_size: int) -> np.ndarray:
+    """[B+1] int32 pv prefix offsets for one batch (≙ GetAdsOffset,
+    data_feed.cc:3592: ads_offset[k] = first instance row of pv k, final
+    entry = instance count).  Static shape: at most B pvs; unused tail
+    entries repeat n_real so downstream diffs yield empty pvs."""
+    out = np.full((batch_size + 1,), n_real, np.int32)
+    if n_real == 0:
+        out[0] = 0
+        return out
+    if search_ids is None:
+        raise ValueError(
+            "ads_offset needs search_ids (parse_logkey pv data) — without "
+            "them every batch would silently become one page view")
+    sid = search_ids[:n_real]
+    new_pv = np.empty((n_real,), bool)
+    new_pv[0] = True
+    np.not_equal(sid[1:], sid[:-1], out=new_pv[1:])
+    starts = np.nonzero(new_pv)[0]
+    out[:len(starts)] = starts
+    return out

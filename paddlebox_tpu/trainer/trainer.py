@@ -82,12 +82,18 @@ class SparseTrainer:
         # rank_offset) must have the feed actually produce them — fail at
         # construction, not with an in-trace TypeError mid-pass
         need = set(getattr(model, "extra_inputs", ()))
-        have = {"rank_offset"} | {s.name for s in feed_config.string_slots}
+        have = ({"rank_offset", "ads_offset"}
+                | {s.name for s in feed_config.string_slots})
         unknown = need - have
         if unknown:
             raise ValueError(
                 f"model.extra_inputs {sorted(unknown)} are not feed planes "
                 f"this feed supplies (available: {sorted(have)})")
+        if "ads_offset" in need and not feed_config.ads_offset:
+            raise ValueError(
+                "model requires the ads_offset plane — set "
+                "DataFeedConfig(ads_offset=True) (and call "
+                "dataset.preprocess_instance())")
         if "rank_offset" in need:
             if not feed_config.rank_offset:
                 raise ValueError(
@@ -697,12 +703,13 @@ class SparseTrainer:
         views (the reference emits it exclusively under pv merge) — a pv
         split across dense batch cuts would silently see only its
         fragment's peers, so refuse loudly instead."""
-        if self.packer.config.rank_offset \
+        if (self.packer.config.rank_offset
+                or self.packer.config.ads_offset) \
                 and not getattr(dataset, "_pv_grouped", False):
             raise ValueError(
-                "DataFeedConfig(rank_offset=True) requires pv-grouped "
-                "batches — call dataset.preprocess_instance() before "
-                "training (≙ GetRankOffset's whole-pv batches, "
+                "DataFeedConfig(rank_offset/ads_offset) requires "
+                "pv-grouped batches — call dataset.preprocess_instance() "
+                "before training (≙ GetRankOffset's whole-pv batches, "
                 "data_feed.cc:1855)")
 
     def _packed_signature(self, feed: PackedPassFeed):
@@ -883,9 +890,13 @@ class SparseTrainer:
             extras["rank_offset"] = batch.rank_offset
         if batch.aux:
             extras.update(batch.aux)
+        repl_extras = {}
+        if batch.ads_offset is not None:
+            repl_extras["ads_offset"] = batch.ads_offset
         if self._batch_sharding is None:
-            return tuple(jnp.asarray(a) for a in arrs) + (
-                {k: jnp.asarray(v) for k, v in extras.items()},)
+            ex = {k: jnp.asarray(v) for k, v in extras.items()}
+            ex.update({k: jnp.asarray(v) for k, v in repl_extras.items()})
+            return tuple(jnp.asarray(a) for a in arrs) + (ex,)
         out = []
         for i, a in enumerate(arrs):
             if i == 0:  # [S,B,L] — batch dim 1
@@ -896,8 +907,10 @@ class SparseTrainer:
                 sh = self._batch_sharding
             out.append(jax.device_put(a, sh))
         ex_sh = self.topology.sharding(("dp", "sharding"), None)
-        return tuple(out) + (
-            {k: jax.device_put(v, ex_sh) for k, v in extras.items()},)
+        ex = {k: jax.device_put(v, ex_sh) for k, v in extras.items()}
+        ex.update({k: jax.device_put(v, self._replicated)
+                   for k, v in repl_extras.items()})
+        return tuple(out) + (ex,)
 
     def train_pass(self, dataset: SlotDataset, prefetch: int = 4,
                    pack_threads: int = 1,

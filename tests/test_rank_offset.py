@@ -213,3 +213,41 @@ def test_packed_plane_matches_per_batch_packer():
         want = packer.pack(blk).rank_offset
         got = arrays.rank_offset[i * B:(i + 1) * B]
         np.testing.assert_array_equal(got, want)
+
+
+def test_ads_offset_plane():
+    """ads_offset (≙ GetAdsOffset, data_feed.cc:3592): pv prefix offsets
+    per batch, identical between the per-batch packer and the packed feed,
+    and consumable as a model extras input."""
+    import dataclasses as dc
+    from paddlebox_tpu.data import pass_feed as pf
+    from paddlebox_tpu.data.batch_pack import BatchPacker
+    from paddlebox_tpu.data.rank_offset import build_ads_offset
+
+    # direct builder semantics
+    sid = np.array([5, 5, 7, 7, 7, 9], np.uint64)
+    out = build_ads_offset(sid, 6, 8)
+    np.testing.assert_array_equal(out, [0, 2, 5, 6, 6, 6, 6, 6, 6])
+    out0 = build_ads_offset(None, 0, 4)
+    np.testing.assert_array_equal(out0, [0, 0, 0, 0, 0])
+    with pytest.raises(ValueError, match="search_ids"):
+        build_ads_offset(None, 3, 4)
+
+    rng = np.random.default_rng(6)
+    ds, cfg = _pv_dataset(rng, n_pvs=20, n_keys=200)
+    cfg = dc.replace(cfg, ads_offset=True)
+    ds.feed_config = cfg
+    B = 16
+    packer = BatchPacker(cfg, B)
+    arrays = pf.pack_pass(list(ds.batches(B)), cfg, B, prebatched=True)
+    assert arrays.ads_offset is not None
+    for i, blk in enumerate(ds.batches(B)):
+        want = packer.pack(blk).ads_offset
+        np.testing.assert_array_equal(arrays.ads_offset[i], want)
+        # diffs give per-pv ad counts; sum = real instances
+        d = np.diff(want)
+        assert d.sum() == blk.n and (d >= 0).all()
+
+    feed = pf.upload_pass(arrays)
+    assert "ads_offset" in feed.data
+    assert feed.data["ads_offset"].shape == (arrays.n_batches, B + 1)
