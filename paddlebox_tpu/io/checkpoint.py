@@ -17,6 +17,7 @@ Layout:
 from __future__ import annotations
 
 import json
+import math
 import os
 import shutil
 import tempfile
@@ -167,22 +168,52 @@ def load_xbox(engine: BoxPSEngine, path: str) -> np.ndarray:
         keys, shows, clicks, ws_, mf_mat = native
     else:
         keys, shows, clicks, ws_, mfs = [], [], [], [], []
+        # Parity contract: for WRITER-PRODUCED files (save_xbox / the
+        # native dump_writer — plain decimal, single-tab-separated), this
+        # fallback and pbox_load_xbox give the same verdict and the same
+        # reported row index.  Hand-edited exotica (hex floats, '_' digit
+        # grouping, whitespace-padded fields) are outside that contract
+        # and may parse differently between the two.
         with open(path) as f:
-            for line in f:
+            lineno = 0      # counts parsed (non-empty) rows, exactly like
+            for line in f:  # the native parser's -(row+1) — same file,
+                # same reported index on native and fallback hosts
                 parts = line.rstrip("\n").split("\t")
                 if not line.strip():
                     continue
+                lineno += 1
                 if len(parts) != 5:
-                    raise ValueError(f"malformed xbox line: {line[:80]!r}")
-                keys.append(int(parts[0]))
-                shows.append(float(parts[1]))
-                clicks.append(float(parts[2]))
-                ws_.append(float(parts[3]))
-                mf = (np.array(parts[4].split(), np.float32)
-                      if parts[4] else np.zeros((0,), np.float32))
+                    raise ValueError(
+                        f"malformed xbox line {lineno}: {line[:80]!r}")
+                try:
+                    key = int(parts[0])
+                    if not 0 <= key < 1 << 64:
+                        raise ValueError("key out of uint64 range")
+                    stats = [float(parts[1]), float(parts[2]),
+                             float(parts[3])]
+                    with np.errstate(over="ignore"):  # inf rejected below
+                        mf = (np.array(parts[4].split(), np.float32)
+                              if parts[4] else np.zeros((0,), np.float32))
+                except ValueError as e:
+                    raise ValueError(
+                        f"malformed xbox line {lineno}: {line[:80]!r}"
+                    ) from e
+                keys.append(key)
+                # reject overflow-to-inf exactly like the native parser
+                # (pbox_load_xbox), so the same file parses — or fails —
+                # identically on fallback-only hosts
+                if not all(map(math.isfinite, stats)) or \
+                        not np.all(np.isfinite(mf)):
+                    raise ValueError(
+                        f"malformed xbox line {lineno}: non-finite value "
+                        f"in {line[:80]!r}")
+                shows.append(stats[0])
+                clicks.append(stats[1])
+                ws_.append(stats[2])
                 if len(mf) != d:
                     raise ValueError(
-                        f"xbox row mf width {len(mf)} != table dim {d}")
+                        f"malformed xbox line {lineno}: mf width "
+                        f"{len(mf)} != table dim {d}")
                 mfs.append(mf)
         mf_mat = (np.stack(mfs) if mfs
                   else np.zeros((0, d), np.float32))
@@ -207,7 +238,12 @@ def load_xbox(engine: BoxPSEngine, path: str) -> np.ndarray:
     rows["embed_w"] = ws_
     rows["mf"] = np.asarray(mf_mat, np.float32)
     # the dump writes zeros for uncreated embedx (see save_xbox) — derive
-    # mf_size so serving pulls mask exactly like training did
+    # mf_size so serving pulls mask exactly like training did.  SERVING-ONLY
+    # contract: a created row whose embedding trained to exactly all zeros
+    # round-trips as uncreated (served values identical — zeros either way),
+    # but resuming TRAINING from an xbox dump would re-initialize such rows'
+    # embedx; use save_checkpoint/load_checkpoint (which carry mf_size
+    # explicitly) for training resume.
     created = np.any(rows["mf"] != 0.0, axis=1)
     rows["mf_size"] = np.where(created, d, 0).astype(rows["mf_size"].dtype)
     # zero every field the dump does not carry (optimizer state, scores)

@@ -108,7 +108,11 @@ class WuAucCalculator:
         if not finite.all():
             self._nan_inf += float((~finite).sum())
             pred, label, uid = pred[finite], label[finite], uid[finite]
-        self._pred.append(np.clip(pred, 0.0, 1.0))
+        # keep preds UNCLIPPED for ranking: the Mann-Whitney statistic only
+        # needs order, and the reference's computeWuAuc sorts raw
+        # predictions — clipping would collapse out-of-range preds into
+        # artificial ties at 0/1 and shift per-user AUC.
+        self._pred.append(pred)
         self._label.append(label)
         self._uid.append(uid)
 

@@ -19,6 +19,7 @@
 #include <cstdlib>
 #include <cctype>
 #include <cerrno>
+#include <cmath>
 #include <string>
 #include <vector>
 
@@ -101,9 +102,14 @@ long long pbox_load_xbox(const char *buf, long long len, uint64_t *keys,
     const char *line_end = static_cast<const char *>(
         memchr(p, '\n', static_cast<size_t>(end - p)));
     if (!line_end) line_end = end;
-    if (line_end == p) {  // empty line
-      p = line_end + 1;
-      continue;
+    {  // skip blank lines, including whitespace-only separators, exactly
+       // like the Python fallback's `if not line.strip(): continue`
+      const char *q = p;
+      while (q < line_end && isspace(static_cast<unsigned char>(*q))) ++q;
+      if (q == line_end) {
+        p = line_end + 1;
+        continue;
+      }
     }
     char *cur = const_cast<char *>(p);
     char *nxt = nullptr;
@@ -111,27 +117,34 @@ long long pbox_load_xbox(const char *buf, long long len, uint64_t *keys,
     auto field_ok = [&](char *c) {
       return c < le && !isspace(static_cast<unsigned char>(*c));
     };
-    if (!field_ok(cur)) return -(row + 1);
+    // a leading '-' would silently wrap through strtoull; the fallback
+    // rejects negative keys, so reject here too
+    if (!field_ok(cur) || *cur == '-') return -(row + 1);
     errno = 0;
     keys[row] = strtoull(cur, &nxt, 10);
     if (nxt == cur || nxt > le || errno == ERANGE || *nxt != '\t')
       return -(row + 1);
     cur = nxt + 1;
+    // ERANGE on *underflow* (subnormal/zero result) is accepted: %.6g of a
+    // raw f32 training value can legitimately emit e.g. 1e-42, and Python's
+    // float() loads it fine.  Non-finite results reject — both overflow
+    // (1e999 -> HUGE_VAL with ERANGE) and literal inf/nan tokens (parsed
+    // with errno==0) — matching the Python fallback's isfinite gate.
     double *cols[3] = {show, click, embed_w};
     for (int c3 = 0; c3 < 3; ++c3) {
       if (!field_ok(cur)) return -(row + 1);
-      errno = 0;
       cols[c3][row] = strtod(cur, &nxt);
-      if (nxt == cur || nxt > le || errno == ERANGE || *nxt != '\t')
+      if (nxt == cur || nxt > le || !std::isfinite(cols[c3][row]) ||
+          *nxt != '\t')
         return -(row + 1);
       cur = nxt + 1;
     }
     float *out = mf + row * d;
     for (long long j = 0; j < d; ++j) {
       if (!field_ok(cur)) return -(row + 1);
-      errno = 0;
       out[j] = strtof(cur, &nxt);
-      if (nxt == cur || nxt > le || errno == ERANGE) return -(row + 1);
+      if (nxt == cur || nxt > le || !std::isfinite(out[j]))
+        return -(row + 1);
       cur = nxt;
       if (j + 1 < d) {
         if (*cur != ' ') return -(row + 1);

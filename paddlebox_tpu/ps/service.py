@@ -227,7 +227,14 @@ class PSServer:
                         "pass id)")
                 if st["sum"] is None:
                     st["sum"] = dict(req["arrs"])
+                    st["world"] = world
                 else:
+                    if st["world"] != world:
+                        raise ValueError(
+                            f"allreduce key {key!r}: participants disagree "
+                            f"on world size ({st['world']} vs {world}) — a "
+                            "smaller world would complete the collective "
+                            "early with a partial sum")
                     if set(st["sum"]) != set(req["arrs"]):
                         raise ValueError(
                             f"allreduce key {key!r}: participants disagree "
@@ -248,8 +255,14 @@ class PSServer:
                             # the summed arrays) so a retry on the same
                             # key cannot double-count this worker
                             st["count"] -= 1
-                            st["sum"] = {k: st["sum"][k] - v
-                                         for k, v in req["arrs"].items()}
+                            if st["count"] == 0:
+                                # last waiter out: drop the entry entirely
+                                # so a resized-world retry on the same key
+                                # does not trip the world-agreement check
+                                del self._reduces[key]
+                            else:
+                                st["sum"] = {k: st["sum"][k] - v
+                                             for k, v in req["arrs"].items()}
                             raise TimeoutError("ps allreduce timeout")
                 result = st["sum"]
                 st["readers"] += 1

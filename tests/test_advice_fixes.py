@@ -1,6 +1,8 @@
 """Regression tests for failure-path hardening: dead async threads must
 raise, not hang; checkpoint schema drift must zero-init, not KeyError."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -173,3 +175,212 @@ def test_ctr_double_trains_through_the_trainer():
     out = eng.table.bulk_pull(keys)
     assert out["show"].dtype == np.float64
     assert out["show"][0] == big + n    # every record showed key 1 — exact
+
+
+def test_native_load_accepts_subnormal_mf(tmp_path):
+    """strtof sets errno=ERANGE on *underflow* too; a subnormal mf value
+    like 1e-42 (legitimately emitted by %.6g from raw f32 state) must load
+    via the native parser exactly like the Python fallback, while real
+    overflow (1e99) still fails loud."""
+    from paddlebox_tpu.native import dump_writer
+
+    if not dump_writer.available():
+        pytest.skip("native library unavailable")
+    p = str(tmp_path / "sub.txt")
+    with open(p, "w") as f:
+        f.write("7\t1\t0\t1e-310\t1e-42 0.25\n")   # subnormal f64 AND f32
+    keys, show, click, w, mf = dump_writer.load_rows(p, 2)
+    assert keys.tolist() == [7]
+    assert w[0] == float("1e-310")                 # f64 subnormal kept
+    assert mf[0, 0] == np.float32("1e-42")         # f32 subnormal kept
+    assert mf[0, 1] == np.float32(0.25)
+
+    bad = str(tmp_path / "ovf.txt")
+    with open(bad, "w") as f:
+        f.write("7\t1\t0\t0.5\t1e99 0.25\n")       # f32 overflow
+    with pytest.raises(ValueError, match="malformed xbox line 1"):
+        dump_writer.load_rows(bad, 2)
+
+
+def test_wuauc_ranks_raw_out_of_range_preds():
+    """computeWuAuc sorts raw predictions — out-of-range preds must keep
+    their order, not collapse into ties at 0/1 (which would shift AUC)."""
+    from paddlebox_tpu.metrics.auc import WuAucCalculator
+
+    uid = np.ones(4, np.uint64)
+    # two preds above 1.0 with opposite labels: raw order ranks 1.7 (pos)
+    # above 1.2 (neg) -> AUC 3/4; clipping collapses them into a tie at
+    # 1.0 -> average-rank AUC 2.5/4 = 0.625
+    pred = np.array([1.7, 1.2, 0.3, 0.1])
+    label = np.array([1, 0, 1, 0])
+    calc = WuAucCalculator()
+    calc.add_data(pred, label, uid)
+    assert calc.compute()["wuauc"] == 0.75
+    # sanity: the clipped version of the same data really does differ
+    clipped = WuAucCalculator()
+    clipped.add_data(np.clip(pred, 0.0, 1.0), label, uid)
+    assert clipped.compute()["wuauc"] == 0.625
+
+
+def test_allreduce_rejects_world_mismatch():
+    """A participant with a smaller `world` must not complete the
+    collective early with a partial sum — the server rejects the
+    disagreement loudly."""
+    import threading
+
+    from paddlebox_tpu.config import EmbeddingTableConfig
+    from paddlebox_tpu.ps.host_table import ShardedHostTable
+    from paddlebox_tpu.ps.service import PSClient, PSServer
+
+    srv = PSServer(ShardedHostTable(EmbeddingTableConfig(embedding_dim=3)))
+    try:
+        errors = []
+
+        def first():
+            c = PSClient(srv.addr)
+            try:
+                c.allreduce({"x": np.ones(2)}, 3, key="w-0")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        t = threading.Thread(target=first)
+        t.start()
+        # let the world=3 participant arrive first so it records the world
+        deadline = time.time() + 10
+        while "w-0" not in srv._reduces and time.time() < deadline:
+            time.sleep(0.01)
+        assert "w-0" in srv._reduces
+        c2 = PSClient(srv.addr)
+        with pytest.raises(Exception, match="world"):
+            c2.allreduce({"x": np.ones(2)}, 2, key="w-0")
+        # unblock the first participant so the thread exits
+        c3 = PSClient(srv.addr)
+        c4 = PSClient(srv.addr)
+        r3 = [None]
+        t3 = threading.Thread(
+            target=lambda: r3.__setitem__(
+                0, c3.allreduce({"x": np.ones(2)}, 3, key="w-0")))
+        t3.start()
+        out = c4.allreduce({"x": np.ones(2)}, 3, key="w-0")
+        t.join(timeout=30)
+        t3.join(timeout=30)
+        np.testing.assert_allclose(out["x"], [3, 3])
+        assert not errors, errors
+    finally:
+        srv.shutdown()
+
+
+def test_python_fallback_rejects_overflow_like_native(tmp_path, monkeypatch):
+    """The pure-Python load_xbox fallback must fail on overflow-to-inf the
+    same way pbox_load_xbox does — one file, one verdict, regardless of
+    native-lib availability — while subnormals load fine either way."""
+    from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+    from paddlebox_tpu.io.checkpoint import load_xbox
+    from paddlebox_tpu.native import dump_writer
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+
+    monkeypatch.setattr(dump_writer, "load_rows", lambda *a: None)
+
+    def fresh():
+        return BoxPSEngine(EmbeddingTableConfig(
+            embedding_dim=2, shard_num=2,
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+
+    ok = str(tmp_path / "sub.txt")
+    with open(ok, "w") as f:
+        f.write("7\t1\t0\t1e-310\t1e-42 0.25\n")
+    keys = load_xbox(fresh(), ok)
+    assert keys.tolist() == [7]
+
+    bad = str(tmp_path / "ovf.txt")
+    with open(bad, "w") as f:
+        f.write("7\t1\t0\t0.5\t1e99 0.25\n")
+    with pytest.raises(ValueError, match="line 1"):
+        load_xbox(fresh(), bad)
+
+    bad2 = str(tmp_path / "ovf2.txt")
+    with open(bad2, "w") as f:
+        f.write("7\t1\t0\t1e999\t0.1 0.25\n")
+    with pytest.raises(ValueError, match="line 1"):
+        load_xbox(fresh(), bad2)
+
+
+def test_xbox_parsers_agree_on_inf_nan_and_line_numbers(tmp_path):
+    """Literal inf/nan tokens (what %.6g emits from overflowed stats) must
+    fail on BOTH parsers, and a malformed file with a blank separator line
+    must report the SAME row index from both."""
+    from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+    from paddlebox_tpu.io.checkpoint import load_xbox
+    from paddlebox_tpu.native import dump_writer
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+
+    def fresh():
+        return BoxPSEngine(EmbeddingTableConfig(
+            embedding_dim=2, shard_num=2,
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+
+    inf_file = str(tmp_path / "inf.txt")
+    with open(inf_file, "w") as f:
+        f.write("7\t1\t0\tinf\t0.1 0.2\n")
+    nan_file = str(tmp_path / "nan.txt")
+    with open(nan_file, "w") as f:
+        f.write("7\t1\t0\t0.5\tnan 0.2\n")
+    blank_file = str(tmp_path / "blank.txt")
+    with open(blank_file, "w") as f:
+        f.write("7\t1\t0\t0.5\t0.1 0.2\n")
+        f.write("\n")                       # blank separator (base+delta)
+        f.write("9\tbogus\t0\t0.5\t0.1 0.2\n")
+
+    parsers = [False]
+    if dump_writer.available():
+        parsers.append(True)
+    real_load_rows = dump_writer.load_rows
+    try:
+        for use_native in parsers:
+            if not use_native:
+                dump_writer.load_rows = lambda *a: None
+            else:
+                dump_writer.load_rows = real_load_rows
+            for bad in (inf_file, nan_file):
+                with pytest.raises(ValueError, match="line 1"):
+                    load_xbox(fresh(), bad)
+            # blank line does not shift the reported row index
+            with pytest.raises(ValueError, match="line 2"):
+                load_xbox(fresh(), blank_file)
+    finally:
+        dump_writer.load_rows = real_load_rows
+
+
+def test_xbox_parsers_agree_on_whitespace_lines_and_negative_keys(tmp_path):
+    """A whitespace-only separator line must be SKIPPED by both parsers,
+    and a negative key must FAIL on both (strtoull would silently wrap)."""
+    from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+    from paddlebox_tpu.io.checkpoint import load_xbox
+    from paddlebox_tpu.native import dump_writer
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+
+    def fresh():
+        return BoxPSEngine(EmbeddingTableConfig(
+            embedding_dim=2, shard_num=2,
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+
+    ws_file = str(tmp_path / "ws.txt")
+    with open(ws_file, "w") as f:
+        f.write("7\t1\t0\t0.5\t0.1 0.2\n")
+        f.write("   \n")                     # whitespace-only separator
+        f.write("9\t1\t0\t0.5\t0.1 0.2\n")
+    neg_file = str(tmp_path / "neg.txt")
+    with open(neg_file, "w") as f:
+        f.write("-1\t1\t0\t0.5\t0.1 0.2\n")
+
+    parsers = [False] + ([True] if dump_writer.available() else [])
+    real = dump_writer.load_rows
+    try:
+        for use_native in parsers:
+            dump_writer.load_rows = real if use_native else lambda *a: None
+            keys = load_xbox(fresh(), ws_file)
+            assert sorted(keys.tolist()) == [7, 9], keys
+            with pytest.raises(ValueError, match="line 1"):
+                load_xbox(fresh(), neg_file)
+    finally:
+        dump_writer.load_rows = real
