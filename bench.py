@@ -25,14 +25,29 @@ TrainFilesWithProfiler, boxps_worker.cc:1358):
 Geometry (full): 26 sparse slots with variable lengths 1..3 (capacity 3),
 13 dense features, mf_dim=8, 2M-key working set, B=16384.
 
+Supervisor architecture (hang-proof backend init): the driver-invoked
+process is a thin SUPERVISOR that runs the actual bench in a child
+process.  A hung `jax.devices()` (tunnel wedge — exactly what burned
+round 4) cannot be interrupted in-process, but the child is killable:
+the supervisor gives each attempt a bounded backend-init window, kills
+and respawns on a wedge, and keeps retrying until the total budget is
+nearly exhausted — backend-init effectively owns the WHOLE budget,
+because no later phase exists until a backend does.  The child's own
+thread watchdog still handles post-backend phase hangs.  The supervisor
+always prints the final stdout line (best result seen across attempts).
+
 Env knobs: BENCH_BATCH_SIZE, BENCH_BATCHES, BENCH_KEYS, BENCH_TIMEOUT_S,
 BENCH_PACK_THREADS, BENCH_SKIP_SMOKE=1, BENCH_SMOKE_ONLY=1,
-BENCH_LEGACY_FEED=1 (per-batch host pack path), BENCH_STEP_PROFILE=0.
+BENCH_LEGACY_FEED=1 (per-batch host pack path), BENCH_STEP_PROFILE=0,
+BENCH_BACKEND_ATTEMPT_S (per-attempt backend-init window, default 150),
+BENCH_NO_SUPERVISE=1 (single-process debug mode).
 """
 
 import json
 import math
 import os
+import signal
+import subprocess
 import sys
 import threading
 import time
@@ -102,6 +117,9 @@ def emit(value: float, final: bool = False, **extra) -> None:
     line = {"metric": METRIC, "value": round(float(value), 1),
             "unit": "examples/s",
             "vs_baseline": round(float(value) / 1_000_000.0, 4)}
+    if final:
+        line["final"] = True    # the supervisor keys clean-run detection
+        # on this: a mid-run smoke line must never pass for the result
     line.update(extra)
     print(json.dumps(_san(line)), flush=True)
 
@@ -127,6 +145,14 @@ def _watchdog() -> None:
 
 
 def _init_devices(retries: int = 3, delay: float = 5.0):
+    if os.environ.get("BENCH_TEST_HANG_INIT") == "1":
+        # harness-test hook: simulate the round-4 tunnel wedge (a hang,
+        # not an exception — only an outside kill can clear it)
+        time.sleep(10 ** 6)
+    once = os.environ.get("BENCH_TEST_HANG_INIT_ONCE")
+    if once and os.path.exists(once):
+        os.unlink(once)    # next attempt (fresh child) proceeds — models
+        time.sleep(10 ** 6)  # a transient tunnel wedge
     import jax
     last = None
     for attempt in range(retries):
@@ -405,7 +431,10 @@ def run() -> None:
     PACK_THREADS = int(os.environ.get(
         "BENCH_PACK_THREADS", min(8, os.cpu_count() or 1)))
 
-    set_phase("backend-init", 420)
+    # backend-init owns the rest of the budget: there IS no later phase
+    # until a backend exists, and the supervisor (not this watchdog)
+    # handles hung-init kills + respawns
+    set_phase("backend-init", TOTAL_BUDGET)
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         # local validation: the image's sitecustomize pins the 'axon' TPU
         # platform even when JAX_PLATFORMS=cpu; override via jax.config
@@ -415,6 +444,13 @@ def run() -> None:
     devices = _init_devices()
     backend = devices[0].platform
     trace(f"backend up: {backend} x{len(devices)}")
+    # partial evidence the instant the backend answers — if everything
+    # later wedges, the recorded round still proves the chip was reachable
+    record(backend=backend, n_devices=len(devices))
+    emit(0.0, stage="backend-up", backend=backend, n_devices=len(devices))
+    fail = os.environ.get("BENCH_TEST_FAIL_AFTER_INIT")
+    if fail:    # harness-test hook: deterministic post-backend failure
+        raise RuntimeError(fail)
 
     if os.environ.get("BENCH_SKIP_SMOKE") != "1":
         smoke = run_config(
@@ -429,6 +465,10 @@ def run() -> None:
              compile_s=smoke["compile_s"])
         if smoke_only:
             return
+        if os.environ.get("BENCH_TEST_DIE_AFTER_SMOKE") == "1":
+            # harness-test hook: segfault-style death (no except clause,
+            # no watchdog emit) between the smoke and full runs
+            os._exit(9)
 
     full = run_config("full", B, N_BATCHES, N_KEYS, PACK_THREADS)
     emit(full["e2e"], final=True, basis="end_to_end", stage="full",
@@ -441,7 +481,7 @@ def run() -> None:
          trim_frac=full["trim_frac"], timers=full["timers"])
 
 
-def main() -> None:
+def child_main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
     try:
         run()
@@ -455,6 +495,197 @@ def main() -> None:
         with _LOCK:
             _STATE["done"] = True
     sys.exit(0)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: killable, retryable backend init (see module docstring).
+# ---------------------------------------------------------------------------
+
+def _spawn_child(budget_s: float):
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    env["BENCH_TIMEOUT_S"] = str(max(int(budget_s), 30))
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env=env, start_new_session=True)
+
+
+def _kill_child(proc) -> None:
+    # the whole session: the axon plugin may fork helpers that hold the
+    # tunnel socket; a surviving helper would wedge the NEXT attempt too
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    try:
+        proc.kill()
+    except Exception:
+        pass
+    try:
+        proc.wait(timeout=10)
+    except Exception:
+        pass
+
+
+def _parse_result_line(line: str):
+    try:
+        obj = json.loads(line)
+    except ValueError:
+        return None
+    return obj if isinstance(obj, dict) and "metric" in obj else None
+
+
+def _rank(line) -> tuple:
+    """Result-line preference: a clean TERMINAL result (the final emit —
+    stage=full, or stage=smoke under BENCH_SMOKE_ONLY) beats everything;
+    a mid-run smoke line may carry a HIGHER value at its toy geometry and
+    must never shadow the real number.  Otherwise any informative line
+    (an error name or a nonzero partial) by value; the bare backend-up
+    marker only beats having nothing at all."""
+    clean = not line.get("error")
+    terminal = line.get("final") or line.get("stage") == "full"
+    val = float(line.get("value") or 0)
+    informative = bool(line.get("error")) or val > 0
+    return (2 if (clean and terminal) else (1 if informative else 0), val)
+
+
+def _better(a, b):
+    """Pick the preferred of two result lines; tie → the later (b) wins,
+    it has fresher metadata."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a if _rank(a) > _rank(b) else b
+
+
+def supervise() -> None:
+    """Run bench children until one finishes cleanly or the budget is spent.
+    A child that does not report a live backend within its attempt window
+    is killed and respawned (hung jax.devices() is killable only from
+    outside).  Always prints the final stdout line."""
+    hard_deadline = T0 + TOTAL_BUDGET - 15       # grace to emit + flush
+    attempt_window = float(os.environ.get("BENCH_BACKEND_ATTEMPT_S", 150))
+    best = None
+    attempts = 0
+    last_err = ""
+    fast_failures = 0        # consecutive child exits within seconds —
+    # a systematic error (bad import, broken env), not a tunnel wedge;
+    # retrying can't help and would spin the whole budget away
+    prev_sig = None
+    repeat_failures = 0      # same post-backend failure twice in a row —
+    # deterministic, not transient; stop burning budget on it
+
+    while time.time() < hard_deadline - 30 and attempts < 20 \
+            and fast_failures < 3 and repeat_failures < 2:
+        attempts += 1
+        t_attempt = time.time()
+        remaining = hard_deadline - time.time()
+        proc = _spawn_child(remaining)
+        trace(f"supervisor: attempt {attempts} started (pid {proc.pid}, "
+              f"{remaining:.0f}s remaining)")
+        backend_up = threading.Event()
+        out_lines = []
+
+        def pump_stderr(p=proc):
+            for ln in p.stderr:
+                sys.stderr.write(ln)
+                sys.stderr.flush()
+                if "backend up:" in ln:
+                    backend_up.set()
+
+        def pump_stdout(p=proc):
+            for ln in p.stdout:
+                if ln.strip():
+                    out_lines.append(ln.strip())
+                    sys.stderr.write(f"[child stdout] {ln}")
+                    sys.stderr.flush()
+
+        te = threading.Thread(target=pump_stderr, daemon=True)
+        to = threading.Thread(target=pump_stdout, daemon=True)
+        te.start()
+        to.start()
+
+        # window for the backend to come up; a wedge here is killable
+        init_deadline = min(time.time() + attempt_window, hard_deadline)
+        while time.time() < init_deadline and proc.poll() is None \
+                and not backend_up.is_set():
+            time.sleep(1)
+
+        if not backend_up.is_set() and proc.poll() is None:
+            trace(f"supervisor: attempt {attempts} backend wedged "
+                  f"after {attempt_window:.0f}s — killing")
+            last_err = "backend-init wedged (jax.devices() hang)"
+            _kill_child(proc)
+            continue
+
+        # backend is up (or the child already exited): let it run to the
+        # hard deadline; its own watchdog handles phase hangs
+        killed = False
+        while proc.poll() is None and time.time() < hard_deadline:
+            time.sleep(1)
+        if proc.poll() is None:
+            trace("supervisor: hard deadline — killing child")
+            last_err = "hard deadline during bench"
+            _kill_child(proc)
+            killed = True
+        te.join(timeout=5)
+        to.join(timeout=5)
+
+        attempt_best = None
+        for ln in out_lines:
+            attempt_best = _better(attempt_best, _parse_result_line(ln))
+        best = _better(best, attempt_best)
+        if attempt_best is not None and _rank(attempt_best)[0] == 2 \
+                and float(attempt_best.get("value") or 0) > 0:
+            break                     # clean TERMINAL result — done
+        if attempt_best is not None and attempt_best.get("error"):
+            last_err = str(attempt_best["error"])
+        elif not killed and proc.returncode:
+            last_err = (f"child died rc={proc.returncode} "
+                        "without reporting (segfault/OOM?)")
+        if best is not None and float(best.get("value") or 0) > 0:
+            # got a number, but not a clean terminal result; retry only
+            # if a full re-run plausibly fits
+            if hard_deadline - time.time() < 420:
+                break
+        if time.time() - t_attempt < 15 and not backend_up.is_set():
+            fast_failures += 1
+        else:
+            fast_failures = 0
+        if backend_up.is_set() and not killed:
+            # the child failed on its own after a live backend — if the
+            # exact same failure repeats, it is deterministic
+            sig = (str(attempt_best.get("error"))
+                   if attempt_best and attempt_best.get("error")
+                   else f"rc={proc.returncode}")
+            repeat_failures = repeat_failures + 1 if sig == prev_sig else 1
+            prev_sig = sig
+        trace(f"supervisor: attempt {attempts} ended without a clean "
+              f"result ({hard_deadline - time.time():.0f}s remaining)")
+        time.sleep(2)
+
+    if best is None:
+        best = {"metric": METRIC, "value": 0.0, "unit": "examples/s",
+                "vs_baseline": 0.0}
+    if not best.get("error") and _rank(best)[0] != 2:
+        # never a bare 0.0 — and never a mid-run smoke line passing for a
+        # clean result: anything short of a clean terminal line carries
+        # the supervisor's failure context
+        best["error"] = last_err or "no clean terminal result"
+    best["supervisor_attempts"] = attempts
+    best["elapsed_s"] = round(time.time() - T0, 1)
+    print(json.dumps(_san(best)), flush=True)
+    sys.exit(0)
+
+
+def main() -> None:
+    if os.environ.get("BENCH_CHILD") == "1" \
+            or os.environ.get("BENCH_NO_SUPERVISE") == "1":
+        child_main()
+    else:
+        supervise()
 
 
 if __name__ == "__main__":

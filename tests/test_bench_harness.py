@@ -103,3 +103,93 @@ def test_phase_budget_capped_by_global_deadline(bench):
     hard = bench.T0 + bench.TOTAL_BUDGET - 20
     bench.set_phase("x", budget_s=10 ** 9)
     assert bench._STATE["deadline"] <= hard
+
+
+# -- supervisor: killable backend init (the round-4 failure mode) -----------
+
+BENCH_PATH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+
+
+def _run_bench(env_extra, timeout):
+    import subprocess
+    env = dict(os.environ)
+    env.update(env_extra)
+    proc = subprocess.run(
+        [sys.executable, BENCH_PATH], capture_output=True, text=True,
+        env=env, timeout=timeout)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr tail: {proc.stderr[-800:]}"
+    return json.loads(lines[-1]), proc.stderr
+
+
+def test_supervisor_kills_hung_backend_and_reports(tmp_path):
+    """A jax.devices() hang must not eat the whole budget: the supervisor
+    kills the wedged child, retries, and still prints one parseable JSON
+    line with the wedge named."""
+    line, err = _run_bench({
+        "BENCH_TEST_HANG_INIT": "1",
+        "BENCH_BACKEND_ATTEMPT_S": "5",
+        "BENCH_TIMEOUT_S": "60"}, timeout=90)
+    assert line["value"] == 0.0
+    assert "wedged" in line.get("error", "")
+    assert line["supervisor_attempts"] >= 2      # it retried
+    assert "killing" in err
+
+
+def test_supervisor_recovers_from_transient_hang(tmp_path):
+    """First attempt wedges (transient tunnel failure), second succeeds:
+    the recorded result is the successful smoke run, not 0.0."""
+    marker = str(tmp_path / "hang_once")
+    open(marker, "w").close()
+    line, _err = _run_bench({
+        "BENCH_TEST_HANG_INIT_ONCE": marker,
+        "BENCH_FORCE_CPU": "1",
+        "BENCH_SMOKE_ONLY": "1",
+        "BENCH_BACKEND_ATTEMPT_S": "10",
+        "BENCH_TIMEOUT_S": "240"}, timeout=260)
+    assert line["value"] > 0
+    assert "error" not in line
+    assert line["supervisor_attempts"] == 2
+    assert line["stage"] == "smoke"
+
+
+def test_better_prefers_clean_full_over_higher_value_smoke(bench):
+    smoke = {"metric": bench.METRIC, "value": 9999.0, "stage": "smoke"}
+    full = {"metric": bench.METRIC, "value": 1200.0, "stage": "full"}
+    assert bench._better(smoke, full) is full
+    assert bench._better(full, smoke) is full
+    # error-free full still beats an errored full partial with more value
+    part = {"metric": bench.METRIC, "value": 99999.0, "stage": "full",
+            "error": "watchdog: ..."}
+    assert bench._better(part, full) is full
+    # an error line beats the bare backend-up marker at equal value
+    up = {"metric": bench.METRIC, "value": 0.0, "stage": "backend-up"}
+    err = {"metric": bench.METRIC, "value": 0.0, "error": "died"}
+    assert bench._better(up, err) is err
+    assert bench._better(err, up) is err
+
+
+def test_supervisor_stops_on_repeated_deterministic_failure():
+    """A post-backend failure that repeats identically must stop the retry
+    loop (deterministic, not transient) — and the final line carries it."""
+    line, err = _run_bench({
+        "BENCH_FORCE_CPU": "1",
+        "BENCH_TEST_FAIL_AFTER_INIT": "boom-deterministic",
+        "BENCH_BACKEND_ATTEMPT_S": "30",
+        "BENCH_TIMEOUT_S": "600"}, timeout=300)
+    assert "boom-deterministic" in line.get("error", "")
+    assert line["supervisor_attempts"] <= 2      # stopped early, not 20
+
+
+def test_supervisor_smoke_line_never_shadows_dead_full_run():
+    """A clean MID-RUN smoke line must not pass for the round result when
+    the child dies before the full run: the final line keeps the smoke
+    value (best partial evidence) but carries an error naming the death."""
+    line, _err = _run_bench({
+        "BENCH_FORCE_CPU": "1",
+        "BENCH_TEST_DIE_AFTER_SMOKE": "1",
+        "BENCH_BACKEND_ATTEMPT_S": "30",
+        "BENCH_TIMEOUT_S": "360"}, timeout=380)
+    assert line.get("error"), line                # never a clean fake
+    assert line["value"] > 0                      # smoke evidence kept
+    assert line.get("stage") == "smoke"
