@@ -302,6 +302,44 @@ def _build_plans(idx_all, dims, eff):
     return jax.lax.map(one, idx_all)
 
 
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _build_static_planes(plans, labels_all, slot_ids, dims, eff, shape_slb):
+    """Static sorted-domain payload planes (per batch, feed-time):
+
+      bs       [p_pad_kept] int32 — pooled-grad source index b*S + s of
+               each kept sorted position (the push crossing gathers the
+               [B*S, 1+D] dynamic grad matrix by this)
+      labelcol [p_pad_kept] f32  — the occurrence's instance label
+               (g_click never changes within a pass, so it never crosses)
+      slotcol  [p_pad_kept] f32  — slot id x first_occ, pre-scaled so the
+               hot step's slot column is a ready constant
+
+    Everything derives from (plan.perm, labels, slot layout) — training-
+    state-independent, so it belongs to the pass build, not the hot loop
+    (≙ CopyForPush reading slot/label straight from the batch layout it
+    owns, box_wrapper.cu:1168)."""
+    s, l, b = shape_slb
+    kd = eff or dims
+    p0 = dims.p_pad - kd.p_pad
+
+    def one(plan, labels_b):
+        perm_full = jnp.concatenate(
+            [plan["perm"],
+             jnp.zeros((dims.p_pad - dims.p,), jnp.int32)])
+        perm_k = perm_full[p0:]                    # kept sorted suffix
+        s_of = perm_k // (l * b)
+        b_of = perm_k % b
+        labels1 = labels_b if labels_b.ndim == 1 else labels_b[:, 0]
+        slotcol = (jnp.take(slot_ids.astype(jnp.float32), s_of)
+                   * plan["first_occ"])
+        return {
+            "bs": (b_of * s + s_of).astype(jnp.int32),
+            "labelcol": jnp.take(labels1.astype(jnp.float32), b_of),
+            "slotcol": slotcol,
+        }
+    return jax.lax.map(lambda args: one(*args), (plans, labels_all))
+
+
 def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
                 sharding=None) -> PackedPassFeed:
     """H2D once + one relayout jit into the step-ready stacked layout.
@@ -364,7 +402,8 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
                           host_valid=h.valid if h.uid is not None else None)
 
 
-def precompute_plans(feed: PackedPassFeed, dims, eff=None) -> None:
+def precompute_plans(feed: PackedPassFeed, dims, eff=None,
+                     slot_ids=None) -> None:
     """Per-batch sorted-spmm plans, built on device in one jit and kept
     resident (≙ the pass-scope dedup/index build of box_wrapper_impl.h:129:
     the sort is data-independent of the training state, so it runs once at
@@ -373,9 +412,21 @@ def precompute_plans(feed: PackedPassFeed, dims, eff=None) -> None:
     eff (sorted_spmm.trimmed_dims, shared by ALL batches so the stacked
     plan arrays are homogeneous): trim leading padding occurrences from the
     kernel worklist — the caller derives it from the max real-occurrence
-    count over the pass's batches."""
+    count over the pass's batches.
+
+    slot_ids [S]: also build the static payload planes (bs/labelcol/
+    slotcol — see _build_static_planes) so the push crossing moves only the
+    dynamic 1+D grad columns.  Multi-task feeds (labels [N, B, T]) use
+    per-task cvm columns at step time, so planes are built only for 1-D
+    (or single-column) labels."""
     feed.plans = _build_plans(feed.data["indices"], dims, eff)
     feed.plan_dims = dims
+    labels = feed.data["labels"]
+    if slot_ids is not None and (labels.ndim == 2 or labels.shape[-1] == 1):
+        n, s, l, b = feed.data["indices"].shape
+        feed.plans.update(_build_static_planes(
+            feed.plans, labels, jnp.asarray(slot_ids), dims, eff,
+            (s, l, b)))
 
 
 def slice_batch(tree, i):
@@ -386,6 +437,12 @@ def slice_batch(tree, i):
 
 def plan_tuple(p: Dict[str, jnp.ndarray]):
     """Plans dict (one batch) → the positional tuple build_plan returns —
-    single source of the field order for every consumer."""
-    return (p["rows2d"], p["perm"], p["inv_perm"], p["ch"], p["tl"],
+    single source of the field order for every consumer.  When the static
+    payload planes are present (precompute_plans with slot_ids) the tuple
+    extends to 11 fields; mxu_path keys the narrow-crossing push on the
+    length."""
+    base = (p["rows2d"], p["perm"], p["inv_perm"], p["ch"], p["tl"],
             p["fg"], p["fs"], p["first_occ"])
+    if "bs" in p:
+        return base + (p["bs"], p["labelcol"], p["slotcol"])
+    return base

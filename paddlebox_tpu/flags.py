@@ -96,3 +96,9 @@ define_flag("mxu_crossing", "auto",
             "sorted<->canonical crossing lowering for the mxu sparse path: "
             "take | sort | auto (auto = time both once per geometry on the "
             "live backend; ops/crossing.py)")
+define_flag("mxu_crossing_bf16", False,
+            "move the mxu path's sorted<->canonical crossings in bfloat16 "
+            "— halves the bytes of the dominant step cost (BENCH_r03: two "
+            "~8.2ms crossings of a 34.6ms step) at ~4e-3 relative error on "
+            "pulled values / push grads; the optimizer still accumulates "
+            "f32.  Read at step-BUILD time, like sharded_exchange_bf16")

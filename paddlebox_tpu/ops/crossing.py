@@ -54,11 +54,14 @@ def _bench_once(fn, args, reps: int = 3) -> float:
     return (time.perf_counter() - t0) / reps
 
 
-def best_mode(take_rows: int, sort_n: int, w: int, backend: str) -> str:
+def best_mode(take_rows: int, sort_n: int, w: int, backend: str,
+              dtype: str = "float32") -> str:
     """Measured winner for a crossing that a "take" lowering serves with
     `take_rows` output rows and a "sort" lowering serves with a `sort_n`-
-    element w+1-operand sort.  Measurements cached per geometry; the flag
-    is read OUTSIDE the cache so pinning works after a tuned pass too."""
+    element w+1-operand sort.  Measurements cached per geometry (including
+    the crossing dtype — bf16 halves the bytes and shifts the take/sort
+    break-even); the flag is read OUTSIDE the cache so pinning works after
+    a tuned pass too."""
     mode = flags.get_flags("mxu_crossing")
     if mode not in ("take", "sort", "auto"):
         raise ValueError(
@@ -67,13 +70,15 @@ def best_mode(take_rows: int, sort_n: int, w: int, backend: str) -> str:
         return mode
     if backend == "cpu":
         return "take"       # XLA CPU gathers are fine; sort is the slow one
-    return _measure(take_rows, sort_n, w, backend)
+    return _measure(take_rows, sort_n, w, backend, dtype)
 
 
 @functools.lru_cache(maxsize=None)
-def _measure(take_rows: int, sort_n: int, w: int, backend: str) -> str:
+def _measure(take_rows: int, sort_n: int, w: int, backend: str,
+             dtype: str = "float32") -> str:
     rng = np.random.default_rng(0)
-    src = jnp.asarray(rng.normal(0, 1, (sort_n, w)).astype(np.float32))
+    src = jnp.asarray(rng.normal(0, 1, (sort_n, w)).astype(
+        np.float32)).astype(dtype)
     idx = jnp.asarray(
         rng.integers(0, sort_n, take_rows).astype(np.int32))
     dest = jnp.asarray(rng.permutation(sort_n).astype(np.int32))

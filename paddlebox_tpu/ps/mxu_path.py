@@ -82,16 +82,23 @@ def _pull_table(ws: Dict[str, jnp.ndarray], dims: sp.SpmmDims) -> jnp.ndarray:
     return tab
 
 
-def pool_cvm_values(v: jnp.ndarray, use_cvm: bool = True) -> jnp.ndarray:
+def pool_cvm_values(v: jnp.ndarray, use_cvm: bool = True,
+                    premasked: bool = False) -> jnp.ndarray:
     """Canonical per-occurrence pull values [S, L, B, 3+D+1] (last col =
     mf_size) → pooled [B, S, 3+D].  Shared by the single-chip path and the
-    shard_map'd multi-chip step (which pools its LOCAL batch shard)."""
-    d = v.shape[-1] - 4
-    created = (v[..., 3 + d:] > 0).astype(v.dtype)         # [S,L,B,1]
+    shard_map'd multi-chip step (which pools its LOCAL batch shard).
+
+    premasked: v is [S, L, B, 3+D] with the created mask already applied
+    to the mf columns (the mxu path does this in the SORTED domain so the
+    mf_size column never rides the crossing)."""
+    d = v.shape[-1] - (3 if premasked else 4)
+    mf = v[..., 3:3 + d]
+    if not premasked:
+        mf = mf * (v[..., 3 + d:] > 0).astype(v.dtype)     # [S,L,B,1] mask
     show = jnp.sum(v[..., 0], axis=1)                      # [S, B]
     click = jnp.sum(v[..., 1], axis=1)
     w = jnp.sum(v[..., 2], axis=1)
-    mf = jnp.sum(v[..., 3:3 + d] * created, axis=1)        # [S, B, D]
+    mf = jnp.sum(mf, axis=1)                               # [S, B, D]
     if use_cvm:
         show_t = jnp.log(show + 1.0)
         click_t = jnp.log(click + 1.0) - show_t
@@ -156,16 +163,24 @@ def pull_pool_cvm(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
     crossing: sorted→canonical lowering (ops/crossing.py) — "take" gathers
     by inv_perm, "sort" re-sorts keyed by perm (the destination index).
     """
+    from paddlebox_tpu import flags
     from paddlebox_tpu.ops import crossing as cx
     assert crossing in ("take", "sort"), crossing
     s, l, b = shape_slb
     d = ws["mf"].shape[1] + _ex_dim(ws)
-    rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
+    rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan[:8]
     eff = plan_eff_dims(plan, dims)
     tab = _pull_table(ws, dims)
     g = sp.gather_sorted(tab, rows2d, ch, tl, fg, eff or dims,
-                         interpret=interpret)              # [W, p_pad]
-    w = 3 + d + 1
+                         interpret=interpret)              # [3+D+1, p_pad]
+    # created-mask the mf rows in the SORTED domain: the mf_size column is
+    # consumed here and never rides the crossing (w shrinks by 1, and the
+    # canonical-domain mask multiply disappears)
+    created = (g[3 + d:4 + d] > 0).astype(g.dtype)         # [1, p_pad]
+    g = jnp.concatenate([g[:3], g[3:3 + d] * created], axis=0)
+    w = 3 + d
+    if flags.get_flags("mxu_crossing_bf16"):
+        g = g.astype(jnp.bfloat16)
     if crossing == "sort":
         if eff is not None:
             # dropped (row-0) positions re-enter as leading zero columns —
@@ -180,8 +195,8 @@ def pull_pool_cvm(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
         # occurrences whose pull value is exactly zero — clamp + mask
         v = jnp.take(g.T, jnp.maximum(inv_perm, 0), axis=0)
         v = v * (inv_perm >= 0).astype(v.dtype)[:, None]
-    v = v.reshape(s, l, b, w)
-    return pool_cvm_values(v, use_cvm)
+    v = v.reshape(s, l, b, w).astype(jnp.float32)
+    return pool_cvm_values(v, use_cvm, premasked=True)
 
 
 def push_and_update(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
@@ -197,47 +212,93 @@ def push_and_update(ws: Dict[str, jnp.ndarray], plan, dims: sp.SpmmDims,
     ins_cvm [B, 2]; slot_ids [S].
     crossing: canonical→sorted lowering (ops/crossing.py) — "take" gathers
     by perm, "sort" re-sorts keyed by inv_perm (the destination index).
+
+    When the plan carries static sorted-domain planes (len > 8: bs,
+    labelcol, slotcol — pass_feed builds them at feed time), only the
+    DYNAMIC payload columns cross (g_embed + D×g_mf = 1+D channels):
+    g_show ≡ 1 rides as a constant, g_click and slot are feed-time planes
+    (the label and slot of an occurrence never change within a pass), and
+    the crossing gathers from the [B*S, 1+D] pooled-grad matrix instead of
+    a materialized [S, L, B, D+4] broadcast — the payload is constant over
+    L, so the broadcast carried 3x redundant rows through the crossing.
+    ≙ CopyForPush building the payload directly per key slot,
+    box_wrapper.cu:1168.
     """
+    from paddlebox_tpu import flags
     from paddlebox_tpu.ops import crossing as cx
     assert crossing in ("take", "sort"), crossing
     s, l, b = idx_slb.shape
     d = ws["mf"].shape[1] + _ex_dim(ws)
     n = ws["show"].shape[0]
     w = d + 4
-    rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan
+    rows2d, perm, inv_perm, ch, tl, fg, fs, first_occ = plan[:8]
     eff = plan_eff_dims(plan, dims)
     kd = eff or dims
+    bf16 = bool(flags.get_flags("mxu_crossing_bf16"))
 
-    payload = push_payload(d_pooled, ins_cvm, slot_ids, (s, l, b))
-    flat = payload.reshape(dims.p, w)
-    if crossing == "sort":
-        # destination = this element's sorted position (shifted kept-domain
-        # position when trimmed: negatives sort first = dropped prefix)
-        srt_cm = cx.permute_by_dest(tuple(flat.T), inv_perm)   # [w, p]
-        if eff is not None:
-            srt_cm = srt_cm[:, dims.p_pad - eff.p_pad:]
-        pad = kd.p_pad - srt_cm.shape[1]
+    if len(plan) > 8:
+        bs_ids, labelcol, slotcol = plan[8], plan[9], plan[10]
+        # dynamic columns only: [B*S, 1+D] (b-major, bs = b*S + s)
+        p2 = d_pooled[:, :, 2:].reshape(b * s, 1 + d)
+        if bf16:
+            p2 = p2.astype(jnp.bfloat16)
+        if crossing == "sort":
+            # canonical flat [(s,l,b), 1+D] — broadcast over L only here,
+            # in the narrow dynamic slice
+            can = jnp.broadcast_to(
+                jnp.transpose(p2.reshape(b, s, 1 + d), (1, 0, 2))[:, None],
+                (s, l, b, 1 + d)).reshape(dims.p, 1 + d)
+            dyn = cx.permute_by_dest(tuple(can.T), inv_perm)   # [1+D, p]
+            if eff is not None:
+                dyn = dyn[:, dims.p_pad - eff.p_pad:]
+            pad = kd.p_pad - dyn.shape[1]
+            dyn = jnp.concatenate(
+                [dyn, jnp.zeros((1 + d, pad), dyn.dtype)], axis=1)
+        else:
+            dyn = jnp.take(p2, bs_ids, axis=0).T               # [1+D, p_pad]
+        dyn = dyn.astype(jnp.float32)
+        ones = jnp.ones((1, kd.p_pad), jnp.float32)
         srt_cm = jnp.concatenate(
-            [srt_cm, jnp.zeros((w, pad), jnp.float32)], axis=1)
-    elif eff is None:
-        srt = jnp.take(flat, perm, axis=0)                 # sorted domain
-        srt_cm = jnp.concatenate(
-            [srt, jnp.zeros((dims.p_pad - dims.p, w), jnp.float32)]).T
+            [ones, labelcol[None], dyn, slotcol[None]], axis=0)
     else:
-        # trimmed plan: keep the suffix of the full bijection — dropped
-        # row-0 occurrences never scatter (row 0 is reserved,
-        # optimizer.py:17) and sentinel tail positions read canonical 0
-        # but land in the discarded sentinel tile
-        p0 = dims.p_pad - eff.p_pad
-        perm_k = jnp.concatenate(
-            [perm, jnp.zeros((dims.p_pad - dims.p,), jnp.int32)])[p0:]
-        srt_cm = jnp.take(flat, perm_k, axis=0).T
-    # slot column: keep only each row's FIRST occurrence (plan mask), so the
-    # scatter-sum returns that occurrence's slot exactly — no averaging, and
-    # keys appearing under several slots resolve deterministically
-    # (≙ the reference's per-key slot from its merge position,
-    # box_wrapper.cu:417 PushMergeCopy)
-    srt_cm = srt_cm.at[w - 1, :].mul(first_occ)
+        # NOTE: mxu_crossing_bf16 is intentionally NOT applied here — the
+        # legacy payload carries the slot-id column, which must stay exact
+        # (ids beyond 8 mantissa bits would round in bf16 and silently
+        # break the optimizer's exact slot matches: nodeid_slot,
+        # slot_mf_dims), so the bandwidth lever only pays on the planes
+        # path where slot rides a separate static f32 plane.
+        payload = push_payload(d_pooled, ins_cvm, slot_ids, (s, l, b))
+        flat = payload.reshape(dims.p, w)
+        if crossing == "sort":
+            # destination = this element's sorted position (shifted
+            # kept-domain position when trimmed: negatives sort first =
+            # dropped prefix)
+            srt_cm = cx.permute_by_dest(tuple(flat.T), inv_perm)   # [w, p]
+            if eff is not None:
+                srt_cm = srt_cm[:, dims.p_pad - eff.p_pad:]
+            pad = kd.p_pad - srt_cm.shape[1]
+            srt_cm = jnp.concatenate(
+                [srt_cm, jnp.zeros((w, pad), srt_cm.dtype)], axis=1)
+        elif eff is None:
+            srt = jnp.take(flat, perm, axis=0)             # sorted domain
+            srt_cm = jnp.concatenate(
+                [srt, jnp.zeros((dims.p_pad - dims.p, w), srt.dtype)]).T
+        else:
+            # trimmed plan: keep the suffix of the full bijection — dropped
+            # row-0 occurrences never scatter (row 0 is reserved,
+            # optimizer.py:17) and sentinel tail positions read canonical 0
+            # but land in the discarded sentinel tile
+            p0 = dims.p_pad - eff.p_pad
+            perm_k = jnp.concatenate(
+                [perm, jnp.zeros((dims.p_pad - dims.p,), jnp.int32)])[p0:]
+            srt_cm = jnp.take(flat, perm_k, axis=0).T
+        srt_cm = srt_cm.astype(jnp.float32)
+        # slot column: keep only each row's FIRST occurrence (plan mask), so
+        # the scatter-sum returns that occurrence's slot exactly — no
+        # averaging, and keys appearing under several slots resolve
+        # deterministically (≙ the reference's per-key slot from its merge
+        # position, box_wrapper.cu:417 PushMergeCopy)
+        srt_cm = srt_cm.at[w - 1, :].mul(first_occ)
     delta = sp.scatter_add_sorted(srt_cm, rows2d, ch, tl, fs, kd,
                                   interpret=interpret)     # [D+4, n_kernel]
     acc = acc_from_delta(delta, n, d_main=ws["mf"].shape[1])

@@ -281,12 +281,40 @@ class PSClient:
     (3-retry-then-raise ≙ ps_gpu_wrapper.cc:388-419)."""
 
     def __init__(self, addr: Tuple[str, int], retries: int = 3,
-                 retry_sleep: float = 0.5):
+                 retry_sleep: float = 0.5,
+                 max_frame: int = wire.MAX_FRAME):
         self.addr = tuple(addr)
         self.retries = retries
         self.retry_sleep = retry_sleep
+        # soft frame budget for transparent chunking of the row verbs
+        # (≙ brpc_ps_client splitting a bulk request over shard requests):
+        # callers never split by hand; a whole-pass pull through
+        # RemoteTableAdapter chunks here instead of tripping _send's cap
+        self.max_frame = max_frame
+        self._row_bytes_est = 512       # adapted from observed responses
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+
+    def _chunk_counts(self, n_keys: int, bytes_per_row: int):
+        """Split n_keys so each frame stays well under max_frame (4x
+        headroom for codec overhead + field alignment)."""
+        per = max(1, int(self.max_frame // 4 // max(bytes_per_row, 1)))
+        out = []
+        done = 0
+        while done < n_keys:
+            c = min(per, n_keys - done)
+            out.append((done, c))
+            done += c
+        return out or [(0, 0)]
+
+    @staticmethod
+    def _rows_bytes(rows: Dict[str, np.ndarray]) -> int:
+        """Wire bytes per row of a rows dict (key + per-field payload)."""
+        tot = 8    # key
+        for v in rows.values():
+            a = np.asarray(v)
+            tot += a.dtype.itemsize * (int(np.prod(a.shape[1:])) or 1)
+        return tot
 
     def _call(self, req: Dict, retry: bool = True,
               timeout: float = 60) -> Dict:
@@ -325,21 +353,59 @@ class PSClient:
     # -- verbs (table=None → the default table) -----------------------------
     def pull_sparse(self, keys: np.ndarray, table: Optional[str] = None,
                     create: bool = False) -> Dict[str, np.ndarray]:
-        return self._call({"cmd": "pull_sparse", "keys": np.asarray(keys),
-                           "table": table, "create": create})["rows"]
+        keys = np.asarray(keys)
+        parts = []
+        lo = 0
+        while True:
+            # re-derive the chunk width each round: the first response
+            # teaches the real row width, so the rest of THIS call already
+            # uses right-sized chunks (not just future calls)
+            per = max(1, int(self.max_frame // 4
+                             // max(self._row_bytes_est, 1)))
+            c = min(per, len(keys) - lo)
+            rows = self._call({"cmd": "pull_sparse",
+                               "keys": keys[lo:lo + c],
+                               "table": table, "create": create})["rows"]
+            if c:   # adapt the estimate to the real schema width
+                self._row_bytes_est = max(self._rows_bytes(rows), 8)
+            parts.append(rows)
+            lo += c
+            if lo >= len(keys):
+                break
+        if len(parts) == 1:
+            return parts[0]
+        return {f: np.concatenate([p[f] for p in parts])
+                for f in parts[0]}
 
     def push_sparse(self, keys: np.ndarray, rows: Dict[str, np.ndarray],
                     table: Optional[str] = None):
-        self._call({"cmd": "push_sparse", "keys": np.asarray(keys),
-                    "rows": rows, "table": table})
+        keys = np.asarray(keys)
+        per_row = self._rows_bytes(rows)
+        for lo, c in self._chunk_counts(len(keys), per_row):
+            self._call({"cmd": "push_sparse", "keys": keys[lo:lo + c],
+                        "rows": {f: np.asarray(v)[lo:lo + c]
+                                 for f, v in rows.items()},
+                        "table": table})
 
     def push_sparse_delta(self, keys: np.ndarray,
                           rows: Dict[str, np.ndarray],
                           rows_abs: Optional[Dict[str, np.ndarray]] = None,
                           table: Optional[str] = None):
-        self._call({"cmd": "push_sparse_delta", "keys": np.asarray(keys),
-                    "rows": rows, "rows_abs": rows_abs or {},
-                    "table": table}, retry=False)
+        # chunked like push_sparse; each chunk stays non-idempotent (no
+        # retry) — a mid-sequence failure leaves earlier chunks applied,
+        # the same partial-application contract a single oversized frame
+        # already had at the pass level
+        keys = np.asarray(keys)
+        rows_abs = rows_abs or {}
+        per_row = self._rows_bytes(rows) + self._rows_bytes(rows_abs)
+        for lo, c in self._chunk_counts(len(keys), per_row):
+            self._call({"cmd": "push_sparse_delta",
+                        "keys": keys[lo:lo + c],
+                        "rows": {f: np.asarray(v)[lo:lo + c]
+                                 for f, v in rows.items()},
+                        "rows_abs": {f: np.asarray(v)[lo:lo + c]
+                                     for f, v in rows_abs.items()},
+                        "table": table}, retry=False)
 
     def pull_dense(self, name: str) -> Optional[np.ndarray]:
         return self._call({"cmd": "pull_dense", "name": name})["value"]

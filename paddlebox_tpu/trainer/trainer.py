@@ -256,19 +256,30 @@ class SparseTrainer:
             raise ValueError(f"unknown sparse_path {path!r}")
 
     def _crossing_modes(self, s: int, l: int, b: int,
-                        eff_p_pad: int = None):
+                        eff_p_pad: int = None, planes: bool = False):
         """Resolve the sorted<->canonical crossing lowering per direction
         (ops/crossing.py): pull's take emits p canonical rows, push's take
         emits only the trimmed width — auto mode times each on the live
-        backend once per geometry."""
+        backend once per geometry.
+
+        planes: the plan carries static payload planes, so the push
+        crossing moves only the 1+D dynamic columns (gathered from the
+        [B*S, 1+D] pooled-grad matrix); the pull crossing always drops the
+        mf_size column (premasked in the sorted domain)."""
         from paddlebox_tpu.ops import crossing as cx
         from paddlebox_tpu.ps.mxu_path import _ex_dim
         p = s * l * b
-        w = 3 + int(self.engine.ws["mf"].shape[1]) \
-            + _ex_dim(self.engine.ws) + 1
+        d = int(self.engine.ws["mf"].shape[1]) + _ex_dim(self.engine.ws)
         backend = jax.default_backend()
-        pull = cx.best_mode(p, p, w, backend)
-        push = cx.best_mode(eff_p_pad or p, p, w, backend)
+        dt = ("bfloat16" if flags.get_flags("mxu_crossing_bf16")
+              else "float32")
+        pull = cx.best_mode(p, p, 3 + d, backend, dt)
+        if planes:
+            push = cx.best_mode(eff_p_pad or p, p, 1 + d, backend, dt)
+        else:
+            # legacy payload carries the exact slot column — bf16 never
+            # applies there (mxu_path.push_and_update)
+            push = cx.best_mode(eff_p_pad or p, p, 4 + d, backend)
         return (pull, push)
 
     def _build_step(self):
@@ -644,7 +655,7 @@ class SparseTrainer:
             # lengths are exact, so this is a static bound for the pass)
             per_batch = arrays.lengths.reshape(s, n, b).sum(axis=(0, 2))
             eff = sp.trimmed_dims(dims, int(per_batch.max()))
-            pf.precompute_plans(feed, dims, eff)
+            pf.precompute_plans(feed, dims, eff, slot_ids=self.slot_ids)
         elif path == "mxu_sharded":
             self._precompute_sharded_plans(feed)
         return feed
@@ -723,14 +734,17 @@ class SparseTrainer:
         exch_bf16 = (flags.get_flags("sharded_exchange_bf16")
                      if path == "mxu_sharded" else False)
         crossing = ("take", "take")
+        planes = with_plans and "bs" in feed.plans
         if path == "mxu":
             eff_p_pad = None
             if with_plans:
                 r = feed.plans["rows2d"].shape      # [N, n_chunks, 1, c]
                 eff_p_pad = int(r[1]) * int(r[3])
-            crossing = self._crossing_modes(s, l, b, eff_p_pad)
+            crossing = self._crossing_modes(s, l, b, eff_p_pad, planes)
+        cross_bf16 = bool(flags.get_flags("mxu_crossing_bf16"))
         return (path, with_plans, self.async_dense is not None, crossing,
-                exch_bf16, self.engine.ws["show"].shape[0], (n, s, l, b))
+                exch_bf16, self.engine.ws["show"].shape[0], (n, s, l, b),
+                planes, cross_bf16)
 
     def _build_packed_step(self, feed: PackedPassFeed):
         """Thin wrapper over the same per-path core as _build_step: slice

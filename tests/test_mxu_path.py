@@ -295,3 +295,100 @@ def test_extended_table_trains_through_trainer():
     assert np.isfinite(stats["loss"]) and stats["batches"] == 4
     # the expand embedding actually TRAINS on this path
     assert not np.allclose(np.asarray(eng.ws["mf_ex"]), ws_ex_before)
+
+
+def _static_planes(plan, dims, eff, labels, slot_ids, S, L, B):
+    """Host-side twin of pass_feed._build_static_planes for one batch."""
+    kd = eff or dims
+    p0 = dims.p_pad - kd.p_pad
+    perm_full = np.concatenate([np.asarray(plan[1]),
+                                np.zeros(dims.p_pad - dims.p, np.int32)])
+    perm_k = perm_full[p0:]
+    s_of = perm_k // (L * B)
+    b_of = perm_k % B
+    bs = (b_of * S + s_of).astype(np.int32)
+    labelcol = np.asarray(labels)[b_of].astype(np.float32)
+    slotcol = (np.asarray(slot_ids)[s_of].astype(np.float32)
+               * np.asarray(plan[7]))
+    return plan + (jnp.asarray(bs), jnp.asarray(labelcol),
+                   jnp.asarray(slotcol))
+
+
+@pytest.mark.parametrize("trim", [False, True])
+@pytest.mark.parametrize("crossing", ["take", "sort"])
+def test_push_static_planes_matches_legacy(trim, crossing):
+    """The narrow-crossing push (static bs/labelcol/slotcol planes, only
+    1+D dynamic columns cross) must produce the IDENTICAL post-push
+    working set as the legacy full-payload crossing."""
+    from paddlebox_tpu.ops import sorted_spmm as sp
+    n, D, S, L, B = 300, 4, 5, 3, 16
+    cfg = SparseSGDConfig(mf_create_thresholds=5.0)
+    ws = _make_ws(n, D)
+    idx, lengths, d_pooled, ins_cvm, slot_ids = _batch(n, S, L, B)
+    dims = sp.spmm_dims(S * L * B, n, chunk=8, tile=32)
+    eff = None
+    if trim:
+        eff = sp.trimmed_dims(dims, int((np.asarray(idx) != 0).sum()))
+        assert eff.p_pad < dims.p_pad
+    plan = mxu_path.build_plan(idx, dims, eff)
+    labels = np.asarray(ins_cvm)[:, 1]
+    plan11 = _static_planes(plan, dims, eff, labels, slot_ids, S, L, B)
+
+    legacy = mxu_path.push_and_update(ws, plan, dims, idx, d_pooled,
+                                      ins_cvm, slot_ids, cfg,
+                                      interpret=True, crossing=crossing)
+    got = mxu_path.push_and_update(ws, plan11, dims, idx, d_pooled,
+                                   ins_cvm, slot_ids, cfg,
+                                   interpret=True, crossing=crossing)
+    for k in legacy:
+        np.testing.assert_allclose(
+            np.asarray(got[k]), np.asarray(legacy[k]), atol=1e-6,
+            rtol=1e-6, err_msg=f"field {k}")
+
+
+def test_crossing_bf16_close_to_f32():
+    """FLAGS_mxu_crossing_bf16 moves the crossings in bfloat16: pooled pull
+    and post-push state stay within bf16 tolerance of the f32 path.  The
+    push lever applies on the PLANES path (the legacy payload carries the
+    exact slot column and ignores the flag); slot ids must survive exactly
+    — including ones beyond bf16's 8 mantissa bits."""
+    from paddlebox_tpu import flags
+    n, D, S, L, B = 300, 4, 5, 3, 16
+    cfg = SparseSGDConfig(mf_create_thresholds=5.0)
+    ws = _make_ws(n, D)
+    idx, lengths, d_pooled, ins_cvm, slot_ids = _batch(n, S, L, B)
+    # slot ids that round in bf16 (1234 -> 1232): exactness must hold
+    slot_ids = jnp.asarray(1233 + np.arange(S, dtype=np.int32))
+    dims = mxu_path.make_dims(S * L * B, n)
+    plan = mxu_path.build_plan(idx, dims)
+    labels = np.asarray(ins_cvm)[:, 1]
+    plan11 = _static_planes(plan, dims, None, labels, slot_ids, S, L, B)
+    f32_pull = mxu_path.pull_pool_cvm(ws, plan, dims, (S, L, B), True,
+                                      interpret=True)
+    f32_ws = mxu_path.push_and_update(ws, plan11, dims, idx, d_pooled,
+                                      ins_cvm, slot_ids, cfg, interpret=True)
+    flags.set_flags({"mxu_crossing_bf16": True})
+    try:
+        bf_pull = mxu_path.pull_pool_cvm(ws, plan, dims, (S, L, B), True,
+                                         interpret=True)
+        bf_ws = mxu_path.push_and_update(ws, plan11, dims, idx, d_pooled,
+                                         ins_cvm, slot_ids, cfg,
+                                         interpret=True)
+        legacy_bf_ws = mxu_path.push_and_update(ws, plan, dims, idx,
+                                                d_pooled, ins_cvm, slot_ids,
+                                                cfg, interpret=True)
+    finally:
+        flags.set_flags({"mxu_crossing_bf16": False})
+    np.testing.assert_allclose(np.asarray(bf_pull), np.asarray(f32_pull),
+                               atol=0.3, rtol=2e-2)
+    for k in f32_ws:
+        np.testing.assert_allclose(
+            np.asarray(bf_ws[k]), np.asarray(f32_ws[k]), atol=0.3,
+            rtol=3e-2, err_msg=f"field {k}")
+    # slot ids exact on BOTH paths under the flag
+    touched = np.asarray(f32_ws["slot"]) != np.asarray(ws["slot"])
+    assert touched.any()
+    np.testing.assert_array_equal(np.asarray(bf_ws["slot"])[touched],
+                                  np.asarray(f32_ws["slot"])[touched])
+    np.testing.assert_array_equal(np.asarray(legacy_bf_ws["slot"])[touched],
+                                  np.asarray(f32_ws["slot"])[touched])
