@@ -292,13 +292,18 @@ class PSClient:
         # RemoteTableAdapter chunks here instead of tripping _send's cap
         self.max_frame = max_frame
         self._row_bytes_est = 512       # adapted from observed responses
+        self._rows_learned = False      # first pull probes conservatively
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
+    def _per_chunk(self, bytes_per_row: int) -> int:
+        """Keys per frame so each stays well under max_frame (4x headroom
+        for codec overhead + field alignment) — the single chunk-budget
+        policy for every row verb."""
+        return max(1, int(self.max_frame // 4 // max(bytes_per_row, 1)))
+
     def _chunk_counts(self, n_keys: int, bytes_per_row: int):
-        """Split n_keys so each frame stays well under max_frame (4x
-        headroom for codec overhead + field alignment)."""
-        per = max(1, int(self.max_frame // 4 // max(bytes_per_row, 1)))
+        per = self._per_chunk(bytes_per_row)
         out = []
         done = 0
         while done < n_keys:
@@ -360,14 +365,20 @@ class PSClient:
             # re-derive the chunk width each round: the first response
             # teaches the real row width, so the rest of THIS call already
             # uses right-sized chunks (not just future calls)
-            per = max(1, int(self.max_frame // 4
-                             // max(self._row_bytes_est, 1)))
+            per = self._per_chunk(self._row_bytes_est)
+            if not self._rows_learned:
+                # unlearned estimate: a wide schema (or a different table
+                # than the one previously learned) could overshoot the
+                # hard wire cap on a huge first chunk — probe small, then
+                # the learned width governs
+                per = min(per, 65536)
             c = min(per, len(keys) - lo)
             rows = self._call({"cmd": "pull_sparse",
                                "keys": keys[lo:lo + c],
                                "table": table, "create": create})["rows"]
             if c:   # adapt the estimate to the real schema width
                 self._row_bytes_est = max(self._rows_bytes(rows), 8)
+                self._rows_learned = True
             parts.append(rows)
             lo += c
             if lo >= len(keys):

@@ -1,0 +1,207 @@
+"""Pure-NumPy golden CTR trainer — the AUC-parity comparator.
+
+An INDEPENDENT reimplementation of the full sparse training step with the
+reference's exact semantics (pull mask -> seqpool -> CVM -> MLP -> push
+cvm replacement -> SparseAdagrad lifecycle, ≙ box_wrapper_impl.h:25-632 +
+optimizer.cuh.h:31-130 + ctr_accessor mf-creation), sharing NO code with
+`paddlebox_tpu.ps.mxu_path` / `fast_path` / `optimizer`.  Nothing here is
+vectorized through the framework under test: embedding traffic is
+numpy fancy-indexing + np.add.at, the MLP is hand-written fwd/bwd, the
+dense optimizer is a from-scratch Adam matching optax.adam's update.
+
+tests/test_auc_parity.py trains this and SparseTrainer on the identical
+packed slice (same initial working set, same initial dense params) and
+asserts final-AUC agreement — the BASELINE "AUC parity" gate (config 1:
+plain DNN CTR, 26 sparse + 13 dense, CPU reference).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+class GoldenAdam:
+    """optax.adam(lr) twin: scale_by_adam(b1=.9, b2=.999, eps=1e-8,
+    eps_root=0) with bias correction by step count, then -lr scaling."""
+
+    def __init__(self, params: List[Dict[str, np.ndarray]], lr: float):
+        self.lr = lr
+        self.t = 0
+        self.mu = [{k: np.zeros_like(v) for k, v in p.items()}
+                   for p in params]
+        self.nu = [{k: np.zeros_like(v) for k, v in p.items()}
+                   for p in params]
+
+    def update(self, params, grads):
+        self.t += 1
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        c1 = 1.0 - b1 ** self.t
+        c2 = 1.0 - b2 ** self.t
+        for p, g, mu, nu in zip(params, grads, self.mu, self.nu):
+            for k in p:
+                mu[k] = b1 * mu[k] + (1 - b1) * g[k]
+                nu[k] = b2 * nu[k] + (1 - b2) * g[k] * g[k]
+                p[k] = p[k] - self.lr * (mu[k] / c1) / (
+                    np.sqrt(nu[k] / c2) + eps)
+
+
+class GoldenTrainer:
+    """One pass-resident working set + MLP, trained batch by batch.
+
+    ws0: the engine's initial working set (numpy copies; row 0 reserved).
+    params0: list of {"w", "b"} MLP layers (numpy copies of the jax init).
+    cfg: SparseSGDConfig (adagrad rules only).
+    """
+
+    def __init__(self, ws0: Dict[str, np.ndarray], params0, cfg,
+                 dense_lr: float = 1e-3, use_cvm: bool = True):
+        self.ws = {k: np.array(v, np.float32) if v.dtype != np.int32
+                   else np.array(v) for k, v in ws0.items()}
+        self.params = [{k: np.array(v, np.float32) for k, v in p.items()}
+                       for p in params0]
+        self.cfg = cfg
+        self.use_cvm = use_cvm
+        self.adam = GoldenAdam(self.params, dense_lr)
+        self.preds: List[np.ndarray] = []
+        self.labels: List[np.ndarray] = []
+
+    # -- forward -----------------------------------------------------------
+    def _pull_pool(self, idx_slb: np.ndarray):
+        """[S, L, B] rows -> pooled [B, S, 3+D] with CVM transforms.
+        Padding/unseen occurrences carry row 0 (all-zero) and contribute
+        nothing; mf columns mask by mf_size>0 (pull_box_sparse padding-zero
+        + embedx gating, box_wrapper_impl.h:25)."""
+        ws = self.ws
+        d = ws["mf"].shape[1]
+        show = ws["show"][idx_slb].sum(axis=1)           # [S, B]
+        click = ws["click"][idx_slb].sum(axis=1)
+        w = ws["embed_w"][idx_slb].sum(axis=1)
+        created = (ws["mf_size"][idx_slb] > 0)[..., None]
+        mf = (ws["mf"][idx_slb] * created).sum(axis=1)   # [S, B, D]
+        if self.use_cvm:
+            show_t = np.log(show + 1.0)
+            click_t = np.log(click + 1.0) - show_t
+        else:
+            show_t, click_t = show, click
+        pooled = np.concatenate(
+            [np.stack([show_t, click_t, w], axis=-1), mf], axis=-1)
+        return np.transpose(pooled, (1, 0, 2)).astype(np.float32)
+
+    def _mlp(self, x):
+        acts = [x]
+        h = x
+        for i, layer in enumerate(self.params):
+            h = h @ layer["w"] + layer["b"]
+            if i < len(self.params) - 1:
+                h = np.maximum(h, 0.0)
+            acts.append(h)
+        return h[:, 0], acts
+
+    def _mlp_backward(self, acts, d_logits):
+        """d_logits [B] -> (param grads, d_input)."""
+        grads = [None] * len(self.params)
+        delta = d_logits[:, None]                        # [B, 1]
+        for i in range(len(self.params) - 1, -1, -1):
+            a_in = acts[i]
+            grads[i] = {"w": a_in.T @ delta,
+                        "b": delta.sum(axis=0)}
+            delta = delta @ self.params[i]["w"].T
+            if i > 0:                                    # relu gate
+                delta = delta * (acts[i] > 0)
+        return grads, delta
+
+    # -- optimizer (SparseAdagrad, optimizer.cuh.h:31-130) ------------------
+    def _sparse_push(self, idx_slb, slot_ids, labels, d_pooled):
+        cfg = self.cfg
+        ws = self.ws
+        s, l, b = idx_slb.shape
+        d = ws["mf"].shape[1]
+        n = len(ws["show"])
+        rows = idx_slb.reshape(-1)
+        b_of = np.tile(np.arange(b), s * l)
+        s_of = np.repeat(np.arange(s), l * b)
+
+        g_show = np.zeros(n, np.float64)
+        g_click = np.zeros(n, np.float64)
+        g_embed = np.zeros(n, np.float64)
+        g_mf = np.zeros((n, d), np.float64)
+        np.add.at(g_show, rows, 1.0)
+        np.add.at(g_click, rows, labels[b_of])
+        np.add.at(g_embed, rows, d_pooled[b_of, s_of, 2])
+        np.add.at(g_mf, rows, d_pooled[b_of, s_of, 3:])
+        slot_col = np.zeros(n, np.int32)
+        slot_col[rows[::-1]] = np.asarray(slot_ids)[s_of[::-1]]  # first wins
+
+        touched = (g_show > 0)
+        touched[0] = False
+        g_show = g_show.astype(np.float32)
+        g_click = g_click.astype(np.float32)
+        g_embed = g_embed.astype(np.float32)
+        g_mf = g_mf.astype(np.float32)
+
+        show = np.where(touched, ws["show"] + g_show, ws["show"])
+        click = np.where(touched, ws["click"] + g_click, ws["click"])
+        ws["delta_score"] = np.where(
+            touched,
+            ws["delta_score"] + cfg.nonclk_coeff * (g_show - g_click)
+            + cfg.clk_coeff * g_click, ws["delta_score"])
+        slot = np.where(touched, slot_col, ws["slot"])
+
+        safe = np.where(g_show > 0, g_show, 1.0)
+        lr_embed = np.where(slot == cfg.nodeid_slot, cfg.learning_rate,
+                            cfg.feature_learning_rate)
+        ratio = lr_embed * np.sqrt(
+            cfg.initial_g2sum / (cfg.initial_g2sum + ws["embed_g2sum"]))
+        sg = g_embed / safe
+        new_embed = np.clip(ws["embed_w"] + sg * ratio, cfg.min_bound,
+                            cfg.max_bound)
+        ws["embed_w"] = np.where(touched, new_embed, ws["embed_w"])
+        ws["embed_g2sum"] = np.where(touched, ws["embed_g2sum"] + sg * sg,
+                                     ws["embed_g2sum"])
+
+        # lazy mf creation on POST-accumulation stats; rows created this
+        # push keep their candidate init (optimizer.cuh.h:104-127)
+        score = cfg.nonclk_coeff * (show - click) + cfg.clk_coeff * click
+        create = touched & (ws["mf_size"] == 0) & \
+            (score >= cfg.mf_create_thresholds)
+        mf_touched = touched & (ws["mf_size"] > 0)
+        ws["mf_size"] = np.where(create, d, ws["mf_size"])
+
+        ratio_mf = cfg.mf_learning_rate * np.sqrt(
+            cfg.mf_initial_g2sum / (cfg.mf_initial_g2sum + ws["mf_g2sum"]))
+        sgm = g_mf / safe[:, None]
+        new_mf = np.clip(ws["mf"] + sgm * ratio_mf[:, None],
+                         cfg.mf_min_bound, cfg.mf_max_bound)
+        ws["mf"] = np.where(mf_touched[:, None], new_mf, ws["mf"])
+        ws["mf_g2sum"] = np.where(
+            mf_touched, ws["mf_g2sum"] + (sgm * sgm).sum(axis=1) / d,
+            ws["mf_g2sum"])
+        ws["show"], ws["click"], ws["slot"] = show, click, slot
+
+    # -- one step ----------------------------------------------------------
+    def step(self, idx_slb, slot_ids, dense, labels, valid):
+        pooled = self._pull_pool(idx_slb)                # [B, S, E]
+        bsz = pooled.shape[0]
+        x = np.concatenate([pooled.reshape(bsz, -1), dense], axis=-1)
+        logits, acts = self._mlp(x)
+        preds = 1.0 / (1.0 + np.exp(-logits))
+        wv = valid.astype(np.float32)
+        denom = max(wv.sum(), 1.0)
+        d_logits = (preds - labels) * wv / denom
+        grads, d_x = self._mlp_backward(acts, d_logits)
+        self.adam.update(self.params, grads)
+
+        e = pooled.shape[-1]
+        d_pooled = d_x[:, :pooled.shape[1] * e].reshape(bsz, -1, e)
+        self._sparse_push(idx_slb, slot_ids, labels, d_pooled)
+        self.preds.append(preds[valid])
+        self.labels.append(labels[valid])
+
+    def auc(self) -> float:
+        from paddlebox_tpu.metrics.auc import AucCalculator
+        calc = AucCalculator()
+        calc.add_data(np.concatenate(self.preds),
+                      np.concatenate(self.labels))
+        return calc.compute()["auc"]
