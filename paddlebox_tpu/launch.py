@@ -19,7 +19,7 @@ import signal
 import subprocess
 import sys
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 
 def init_distributed(coordinator: Optional[str] = None,
@@ -101,15 +101,200 @@ def launch(script: str, script_args: List[str], nproc: int,
         return 130
 
 
+def launch_elastic(script: str, script_args: List[str], nproc: int,
+                   elastic_dir: str,
+                   coordinator_host: str = "127.0.0.1",
+                   coordinator_base_port: int = 12400,
+                   min_workers: int = 1,
+                   max_relaunches: int = 3,
+                   heartbeat_ttl: float = 6.0,
+                   log_dir: str = "",
+                   poll_s: float = 0.2) -> int:
+    """Elastic job orchestration: relaunch into a shrunk/regrown world.
+
+    ≙ ElasticManager + launcher cooperating (fleet/elastic/manager.py:131
+    watch loop, :217-233 restart path): workers heartbeat into a TTL'd
+    FileStore (the etcd-prefix equivalent, elastic.FileStore); the
+    launcher watches BOTH process liveness and heartbeats.  On a failure
+    it re-rendezvouses: every surviving worker is stopped, lost ranks are
+    dropped (scale-in), any pending grow request is honored up to the
+    original nproc (scale-out), and a NEW generation spawns with
+    renumbered ranks 0..new_world-1, a fresh coordinator port, and
+    PBOX_ELASTIC_GEN bumped — workers recover via checkpoint auto-resume
+    (io/checkpoint.py), exactly the reference's restart semantics.
+
+    Loss classification (single-host stand-ins for node loss):
+      * exit by SIGKILL            -> the rank's "node" is gone: scale-in
+      * heartbeat expired, alive   -> partitioned: SIGTERM + scale-in
+      * any other nonzero exit     -> crash: rank respawns in the new
+                                      generation (same world size)
+      * exit 0                     -> done; leaves the job quietly
+    Scale-out: write the desired extra worker count into
+    ``<elastic_dir>/grow`` — honored at the next (or a voluntary)
+    re-rendezvous (≙ the reference watching new joiners under the np
+    prefix).
+
+    Returns 0 when every worker of the final generation exits 0; nonzero
+    when the world would drop below min_workers or relaunch budget is
+    exhausted.
+    """
+    from paddlebox_tpu.elastic import FileStore
+
+    os.makedirs(elastic_dir, exist_ok=True)
+    store = FileStore(os.path.join(elastic_dir, "members"),
+                      ttl=heartbeat_ttl)
+    grow_path = os.path.join(elastic_dir, "grow")
+    gen = 0
+    world = nproc
+    relaunches = 0
+
+    def spawn(rank: int, world_size: int, generation: int):
+        env = dict(os.environ)
+        env.update({
+            "PBOX_RANK": str(rank),
+            "PBOX_WORLD_SIZE": str(world_size),
+            "PBOX_COORDINATOR":
+                f"{coordinator_host}:{coordinator_base_port + generation}",
+            "PBOX_ELASTIC_DIR": elastic_dir,
+            "PBOX_ELASTIC_GEN": str(generation),
+        })
+        stdout = None
+        try:
+            if log_dir:
+                os.makedirs(log_dir, exist_ok=True)
+                stdout = open(os.path.join(
+                    log_dir, f"worker-g{generation}-{rank}.log"), "ab")
+            return subprocess.Popen(
+                [sys.executable, script] + script_args,
+                env=env, stdout=stdout,
+                stderr=subprocess.STDOUT if stdout else None)
+        finally:
+            if stdout is not None:
+                stdout.close()          # child holds its own copy
+
+    def read_grow() -> int:
+        try:
+            with open(grow_path) as f:
+                raw = f.read().strip()
+        except FileNotFoundError:
+            return 0
+        os.remove(grow_path)    # consume even when malformed — a bad
+        try:                    # request must not be re-parsed every poll
+            return max(0, int(raw or 0))
+        except ValueError:
+            print(f"[elastic] ignoring malformed grow request {raw!r}",
+                  file=sys.stderr)
+            return 0
+
+    def stop_all(procs):
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in procs.values():
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                p.kill()
+
+    procs = {r: spawn(r, world, gen) for r in range(world)}
+    seen_hb: set = set()    # ranks that registered this generation — a
+    # partition verdict needs a once-alive heartbeat (startup time — jax
+    # import, data load — must never read as a lost node)
+    hb_miss: Dict[int, int] = {}   # consecutive missing-heartbeat polls
+    # required before the partition verdict: an exiting worker deletes its
+    # key a few ms before its process ends — one missed poll is a race,
+    # not a partition
+    miss_quorum = max(3, int(heartbeat_ttl / 2 / poll_s))
+
+    while True:
+        time.sleep(poll_s)
+        lost, crashed = [], []
+        for r, p in list(procs.items()):
+            ret = p.poll()
+            if ret is None:
+                continue
+            if ret == 0:
+                del procs[r]            # done — leaves quietly
+            elif ret == -signal.SIGKILL:
+                lost.append(r)          # "node" gone
+            else:
+                crashed.append(r)
+        # sustained heartbeat loss of a live, once-registered process =
+        # partitioned
+        alive_hb = {int(k.split("-")[1]) for k in store.alive_keys()}
+        for r, p in list(procs.items()):
+            if p.poll() is None and r in seen_hb and r not in alive_hb:
+                hb_miss[r] = hb_miss.get(r, 0) + 1
+                if hb_miss[r] >= miss_quorum:
+                    p.send_signal(signal.SIGTERM)
+                    lost.append(r)
+            else:
+                hb_miss.pop(r, None)
+        seen_hb |= alive_hb
+
+        if not procs and not lost and not crashed:
+            return 0                    # final generation all done
+        if lost or crashed:
+            # failures spend relaunch budget
+            if relaunches >= max_relaunches:
+                stop_all(procs)
+                return 75               # EX_TEMPFAIL: budget exhausted
+            relaunches += 1
+            grow = read_grow()
+        else:
+            # voluntary scale-out: free (no failure happened); a healthy
+            # job must never die because a grow request arrived after the
+            # failure budget was spent
+            grow = read_grow()
+            if not grow:
+                continue
+            if min(len(procs) + grow, nproc) <= len(procs):
+                continue                # already at the nproc cap
+
+        # -- re-rendezvous ------------------------------------------------
+        # stop EVERYTHING first — including just-SIGTERMed partitioned
+        # ranks, so they get the kill escalation + reap and can never keep
+        # mutating shared state (the checkpoint) beside the new generation
+        stop_all(procs)
+        for r in lost + crashed:
+            procs.pop(r, None)
+        for k in store.alive_keys():    # clean the prefix for the new gen
+            store.delete(k)
+        survivors = len(procs) + len(crashed)
+        new_world = min(survivors + grow, nproc)
+        if new_world < min_workers:
+            return 76                   # below quorum
+        gen += 1
+        world = new_world
+        procs = {r: spawn(r, world, gen) for r in range(world)}
+        seen_hb = set()
+        hb_miss = {}
+
+
 def main():
     ap = argparse.ArgumentParser(prog="paddlebox_tpu.launch")
     ap.add_argument("--nproc_per_node", type=int, default=1)
     ap.add_argument("--coordinator", default="127.0.0.1:12355")
     ap.add_argument("--max_restarts", type=int, default=0)
     ap.add_argument("--log_dir", default="")
+    ap.add_argument("--elastic_dir", default="",
+                    help="enable elastic relaunch orchestration on this "
+                         "shared dir (≙ the etcd prefix)")
+    ap.add_argument("--min_workers", type=int, default=1)
+    ap.add_argument("--max_relaunches", type=int, default=3)
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
+    if args.elastic_dir:
+        host, _, port = args.coordinator.rpartition(":")
+        sys.exit(launch_elastic(
+            args.script, args.script_args, args.nproc_per_node,
+            args.elastic_dir,
+            coordinator_host=host or "127.0.0.1",
+            coordinator_base_port=int(port) if port else 12400,
+            min_workers=args.min_workers,
+            max_relaunches=args.max_relaunches, log_dir=args.log_dir))
     sys.exit(launch(args.script, args.script_args, args.nproc_per_node,
                     args.coordinator, args.max_restarts, args.log_dir))
 

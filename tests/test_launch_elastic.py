@@ -90,3 +90,91 @@ def test_tcp_shuffle_transport():
                                   for i in range(8))
     for tr in transports:
         tr.close()
+
+
+# -- elastic relaunch orchestration (≙ ElasticManager + launcher restart
+# path, fleet/elastic/manager.py:131, 217-233) ------------------------------
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_elastic_worker.py")
+
+
+def _read_json(path):
+    import json
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_elastic_relaunch_shrinks_world_after_node_loss(tmp_path):
+    """Rank 1 SIGKILLs itself mid-pass: the launcher re-rendezvouses into
+    a 2-worker generation 1, the job resumes from the shared checkpoint
+    and finishes — exit 0, no lost progress."""
+    from paddlebox_tpu.launch import launch_elastic
+    edir = str(tmp_path / "elastic")
+    rc = launch_elastic(_WORKER, ["kill"], nproc=3, elastic_dir=edir,
+                        min_workers=2, max_relaunches=2,
+                        heartbeat_ttl=4.0)
+    assert rc == 0
+    done = sorted(os.listdir(edir))
+    assert "done-g1-r0" in done and "done-g1-r1" in done
+    assert not any(d.startswith("done-g0") for d in done)
+    final = _read_json(os.path.join(edir, "job_ckpt.json"))
+    assert final == {"step": 40, "gen": 1, "world": 2}
+
+
+def test_elastic_relaunch_detects_heartbeat_partition(tmp_path):
+    """Rank 1 stops heartbeating but stays alive (partition): the launcher
+    must SIGTERM it, scale in, and still finish the job."""
+    from paddlebox_tpu.launch import launch_elastic
+    edir = str(tmp_path / "elastic")
+    rc = launch_elastic(_WORKER, ["partition"], nproc=3, elastic_dir=edir,
+                        min_workers=2, max_relaunches=2,
+                        heartbeat_ttl=3.0)
+    assert rc == 0
+    final = _read_json(os.path.join(edir, "job_ckpt.json"))
+    assert final["gen"] == 1 and final["world"] == 2
+
+
+def test_elastic_grow_request_scales_out(tmp_path):
+    """A pending grow request is honored at the re-rendezvous: the lost
+    rank's capacity is replaced and the new generation runs at full
+    strength again (scale-out, ≙ the reference watching new joiners)."""
+    from paddlebox_tpu.launch import launch_elastic
+    edir = str(tmp_path / "elastic")
+    os.makedirs(edir, exist_ok=True)
+    with open(os.path.join(edir, "grow"), "w") as f:
+        f.write("1")
+    rc = launch_elastic(_WORKER, ["kill"], nproc=3, elastic_dir=edir,
+                        min_workers=2, max_relaunches=2,
+                        heartbeat_ttl=4.0)
+    assert rc == 0
+    final = _read_json(os.path.join(edir, "job_ckpt.json"))
+    assert final["gen"] == 1 and final["world"] == 3
+
+
+def test_elastic_aborts_below_quorum(tmp_path):
+    """Losing a rank with min_workers == nproc must abort, not limp on."""
+    from paddlebox_tpu.launch import launch_elastic
+    edir = str(tmp_path / "elastic")
+    rc = launch_elastic(_WORKER, ["kill"], nproc=3, elastic_dir=edir,
+                        min_workers=3, max_relaunches=2,
+                        heartbeat_ttl=4.0)
+    assert rc == 76
+
+
+def test_elastic_grow_after_spent_budget_keeps_job_alive(tmp_path):
+    """A grow request on a HEALTHY job with exhausted failure budget must
+    not kill it: voluntary scale-out is free, and a no-op grow (already at
+    the nproc cap) is ignored entirely."""
+    from paddlebox_tpu.launch import launch_elastic
+    edir = str(tmp_path / "elastic")
+    os.makedirs(edir, exist_ok=True)
+    # at-cap grow request present from the start; budget zero
+    with open(os.path.join(edir, "grow"), "w") as f:
+        f.write("2")
+    rc = launch_elastic(_WORKER, ["none"], nproc=2, elastic_dir=edir,
+                        min_workers=1, max_relaunches=0,
+                        heartbeat_ttl=4.0)
+    assert rc == 0
+    final = _read_json(os.path.join(edir, "job_ckpt.json"))
+    assert final["gen"] == 0 and final["world"] == 2
+    assert not os.path.exists(os.path.join(edir, "grow"))  # consumed
