@@ -21,6 +21,7 @@ import math
 import os
 import shutil
 import tempfile
+import warnings
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -161,6 +162,15 @@ def load_xbox(engine: BoxPSEngine, path: str) -> np.ndarray:
         engine.end_feed_pass(); engine.begin_pass()
         engine.freeze_for_serving()
     """
+    if getattr(engine, "mode", "train") != "serving":
+        warnings.warn(
+            "load_xbox on a training-mode engine: the xbox dump re-derives "
+            "mf_size as any(mf != 0), so a created row whose embedx "
+            "trained to exactly all zeros round-trips as uncreated and "
+            "would re-initialize on training resume.  Use load_checkpoint "
+            "(TrainCheckpoint.resume) for training resume, or build the "
+            "engine with mode='serving' for a serving path.",
+            UserWarning, stacklevel=2)
     from paddlebox_tpu.native import dump_writer
     d = engine.config.embedding_dim
     native = dump_writer.load_rows(path, d)

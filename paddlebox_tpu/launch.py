@@ -124,7 +124,13 @@ def launch_elastic(script: str, script_args: List[str], nproc: int,
     (io/checkpoint.py), exactly the reference's restart semantics.
 
     Loss classification (single-host stand-ins for node loss):
-      * exit by SIGKILL            -> the rank's "node" is gone: scale-in
+      * FIRST exit by SIGKILL      -> treated as a crash: the rank
+                                      respawns.  On Linux an OOM-killed
+                                      worker also exits -SIGKILL, and a
+                                      transient OOM must not permanently
+                                      shrink capacity.
+      * REPEAT SIGKILL (same rank) -> the rank's "node" really is gone
+                                      (or pathologically OOMs): scale-in
       * heartbeat expired, alive   -> partitioned: SIGTERM + scale-in
       * any other nonzero exit     -> crash: rank respawns in the new
                                       generation (same world size)
@@ -172,19 +178,26 @@ def launch_elastic(script: str, script_args: List[str], nproc: int,
             if stdout is not None:
                 stdout.close()          # child holds its own copy
 
-    def read_grow() -> int:
+    def read_grow(peek: bool = False) -> int:
+        """Parse <elastic_dir>/grow.  Malformed or non-positive requests
+        are always consumed (a bad request must not be re-parsed every
+        poll); a valid positive one is consumed unless peek=True — the
+        voluntary path peeks first so an at-the-cap request stays pending
+        for a failure re-rendezvous that CAN honor it."""
         try:
             with open(grow_path) as f:
                 raw = f.read().strip()
         except FileNotFoundError:
             return 0
-        os.remove(grow_path)    # consume even when malformed — a bad
-        try:                    # request must not be re-parsed every poll
-            return max(0, int(raw or 0))
+        try:
+            val = max(0, int(raw or 0))
         except ValueError:
             print(f"[elastic] ignoring malformed grow request {raw!r}",
                   file=sys.stderr)
-            return 0
+            val = 0
+        if val == 0 or not peek:
+            os.remove(grow_path)
+        return val
 
     def stop_all(procs):
         for p in procs.values():
@@ -198,6 +211,9 @@ def launch_elastic(script: str, script_args: List[str], nproc: int,
                 p.kill()
 
     procs = {r: spawn(r, world, gen) for r in range(world)}
+    sigkills: Dict[int, int] = {}   # rank -> SIGKILL exits across ALL
+    # generations (ranks are renumbered per generation; the single-host
+    # stand-in treats rank r of every generation as the same "node")
     seen_hb: set = set()    # ranks that registered this generation — a
     # partition verdict needs a once-alive heartbeat (startup time — jax
     # import, data load — must never read as a lost node)
@@ -217,7 +233,11 @@ def launch_elastic(script: str, script_args: List[str], nproc: int,
             if ret == 0:
                 del procs[r]            # done — leaves quietly
             elif ret == -signal.SIGKILL:
-                lost.append(r)          # "node" gone
+                # a lone SIGKILL is indistinguishable from a transient OOM
+                # kill — respawn like a crash; only a REPEAT verdict on
+                # the same rank reads as real node loss and scales in
+                sigkills[r] = sigkills.get(r, 0) + 1
+                (lost if sigkills[r] > 1 else crashed).append(r)
             else:
                 crashed.append(r)
         # sustained heartbeat loss of a live, once-registered process =
@@ -246,11 +266,12 @@ def launch_elastic(script: str, script_args: List[str], nproc: int,
             # voluntary scale-out: free (no failure happened); a healthy
             # job must never die because a grow request arrived after the
             # failure budget was spent
-            grow = read_grow()
+            grow = read_grow(peek=True)
             if not grow:
                 continue
             if min(len(procs) + grow, nproc) <= len(procs):
-                continue                # already at the nproc cap
+                continue                # at the nproc cap — leave pending
+            read_grow()                 # honored now: consume it
 
         # -- re-rendezvous ------------------------------------------------
         # stop EVERYTHING first — including just-SIGTERMed partitioned

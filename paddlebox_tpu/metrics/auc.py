@@ -93,6 +93,7 @@ class WuAucCalculator:
         self._pred: List[np.ndarray] = []
         self._label: List[np.ndarray] = []
         self._nan_inf = 0.0
+        self._out_of_range = 0.0
 
     def add_data(self, pred, label, uid, mask=None) -> None:
         pred = np.asarray(pred, np.float64)
@@ -109,9 +110,14 @@ class WuAucCalculator:
             self._nan_inf += float((~finite).sum())
             pred, label, uid = pred[finite], label[finite], uid[finite]
         # keep preds UNCLIPPED for ranking: the Mann-Whitney statistic only
-        # needs order, and the reference's computeWuAuc sorts raw
-        # predictions — clipping would collapse out-of-range preds into
-        # artificial ties at 0/1 and shift per-user AUC.
+        # needs order, and clipping would collapse out-of-range preds into
+        # artificial ties at 0/1 and shift per-user AUC.  NOTE the
+        # reference does NOT rank raw out-of-range preds — its
+        # add_uid_unlock_data PADDLE_ENFORCEs pred in [0,1] and rejects
+        # the record outright; a non-sigmoid head violates that
+        # precondition silently here, so count the violations (surfaced as
+        # out_of_range_rate) the way _nan_inf tracks non-finite preds.
+        self._out_of_range += float(((pred < 0.0) | (pred > 1.0)).sum())
         self._pred.append(pred)
         self._label.append(label)
         self._uid.append(uid)
@@ -120,7 +126,8 @@ class WuAucCalculator:
         if not self._pred or not sum(len(p) for p in self._pred):
             return {"uauc": 0.0, "wuauc": 0.0, "user_cnt": 0.0,
                     "size": 0.0, "nan_inf_rate": 1.0 if self._nan_inf
-                    else 0.0}
+                    else 0.0, "out_of_range_rate": 1.0
+                    if self._out_of_range else 0.0}
         pred = np.concatenate(self._pred)
         label = np.concatenate(self._label)
         uid = np.concatenate(self._uid)
@@ -159,6 +166,10 @@ class WuAucCalculator:
             "nan_inf_rate": float(
                 self._nan_inf / (n + self._nan_inf)) if self._nan_inf
             else 0.0,
+            # ranked records whose pred violates the reference's [0,1]
+            # precondition (they ARE still ranked — see add_data)
+            "out_of_range_rate": float(self._out_of_range / n)
+            if self._out_of_range else 0.0,
         }
 
 

@@ -46,26 +46,35 @@ class _Shard:
         return len(self.keys)
 
     def _native(self):
-        if not self._hash_tried:
-            self._hash_tried = True
-            try:
-                from paddlebox_tpu.native import hash_map
-                if hash_map.available():
-                    h = hash_map.NativeKeyHash(max(len(self.keys), 1024))
-                    if len(self.keys):
-                        h.upsert(self.keys)
-                    self._hash = h
-            except Exception:
-                self._hash = None
-        return self._hash
+        # reentrant from lookup/upsert/rebuild_index, which already hold
+        # the RLock — taken here too so a bare call cannot race the lazy
+        # index build
+        with self.lock:
+            if not self._hash_tried:
+                self._hash_tried = True
+                try:
+                    from paddlebox_tpu.native import hash_map
+                    if hash_map.available():
+                        h = hash_map.NativeKeyHash(max(len(self.keys),
+                                                       1024))
+                        if len(self.keys):
+                            h.upsert(self.keys)
+                        self._hash = h
+                except Exception:
+                    self._hash = None
+            return self._hash
 
     def rebuild_index(self) -> None:
-        """Call after keys/soa were replaced wholesale (load, shrink)."""
-        self._sorted_view = None
-        if self._hash is not None or self._hash_tried:
-            self._hash_tried = False
-            self._hash = None
-            self._native()
+        """Call after keys/soa were replaced wholesale (load, shrink).
+        Takes the shard RLock itself: callers inside load/shrink already
+        hold it (reentrant), and a bare call must not race lookup's lazy
+        index build."""
+        with self.lock:
+            self._sorted_view = None
+            if self._hash is not None or self._hash_tried:
+                self._hash_tried = False
+                self._hash = None
+                self._native()
 
     def lookup(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """→ (rows, found_mask); rows are insertion positions, valid where

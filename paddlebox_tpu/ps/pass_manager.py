@@ -36,9 +36,17 @@ from paddlebox_tpu.utils.timer import TimerRegistry
 
 class BoxPSEngine:
     def __init__(self, config: Optional[EmbeddingTableConfig] = None,
-                 topology: Optional[HybridTopology] = None, seed: int = 0):
+                 topology: Optional[HybridTopology] = None, seed: int = 0,
+                 mode: str = "train"):
+        if mode not in ("train", "serving"):
+            raise ValueError(f"mode must be 'train' or 'serving', "
+                             f"got {mode!r}")
         self.config = config or EmbeddingTableConfig()
         self.topology = topology
+        # declared intent, not enforcement: io/checkpoint.py uses it to
+        # warn when a serving-only loader (load_xbox) feeds a training
+        # engine — the xbox dump cannot round-trip mf_size exactly
+        self.mode = mode
         self.table = ShardedHostTable(self.config, seed=seed)
         self.timers = TimerRegistry()
         self.day_id: Optional[str] = None
@@ -75,6 +83,9 @@ class BoxPSEngine:
         assert not self._feeding, "previous feed pass not closed"
         with self._agent_lock:
             self._agent_keys = []
+        # the pass lifecycle is driven by one coordinator thread;
+        # _agent_lock only guards the add_keys sink
+        # pboxlint: disable-next=PB102 -- single-coordinator lifecycle flag
         self._feeding = True
 
     def add_keys(self, keys: np.ndarray) -> None:
@@ -136,6 +147,7 @@ class BoxPSEngine:
         its end_pass (the reference accepts that staleness — we do not).
         """
         assert self._feeding
+        # pboxlint: disable-next=PB102 -- lifecycle flag, coordinator-only
         self._feeding = False
         uniq = self._dedup_agent_keys()
         if not async_build:
@@ -157,6 +169,8 @@ class BoxPSEngine:
                 self._build_error = e
 
         self._build_error = None
+        # the handoff is coordinator-only: begin_pass joins before clearing
+        # pboxlint: disable-next=PB102 -- coordinator-only thread handoff
         self._build_thread = threading.Thread(target=run, daemon=True)
         self._build_thread.start()
 

@@ -291,8 +291,11 @@ class PSClient:
         # callers never split by hand; a whole-pass pull through
         # RemoteTableAdapter chunks here instead of tripping _send's cap
         self.max_frame = max_frame
-        self._row_bytes_est = 512       # adapted from observed responses
-        self._rows_learned = False      # first pull probes conservatively
+        # learned row width PER TABLE (bytes), adapted from observed
+        # responses — a narrow table's estimate must never size a wide
+        # table's first chunk past the wire cap; guarded by _lock so a
+        # client shared across threads cannot interleave updates
+        self._row_bytes_est: Dict[str, int] = {}
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
@@ -359,26 +362,30 @@ class PSClient:
     def pull_sparse(self, keys: np.ndarray, table: Optional[str] = None,
                     create: bool = False) -> Dict[str, np.ndarray]:
         keys = np.asarray(keys)
+        tname = table or DEFAULT_TABLE
         parts = []
         lo = 0
         while True:
             # re-derive the chunk width each round: the first response
             # teaches the real row width, so the rest of THIS call already
             # uses right-sized chunks (not just future calls)
-            per = self._per_chunk(self._row_bytes_est)
-            if not self._rows_learned:
-                # unlearned estimate: a wide schema (or a different table
-                # than the one previously learned) could overshoot the
-                # hard wire cap on a huge first chunk — probe small, then
-                # the learned width governs
+            with self._lock:
+                learned = self._row_bytes_est.get(tname)
+            per = self._per_chunk(learned if learned is not None else 512)
+            if learned is None:
+                # unlearned TABLE (this one — another table's learned
+                # width says nothing about this schema): a wide schema
+                # could overshoot the hard wire cap on a huge first chunk
+                # — probe small, then the learned width governs
                 per = min(per, 65536)
             c = min(per, len(keys) - lo)
             rows = self._call({"cmd": "pull_sparse",
                                "keys": keys[lo:lo + c],
                                "table": table, "create": create})["rows"]
-            if c:   # adapt the estimate to the real schema width
-                self._row_bytes_est = max(self._rows_bytes(rows), 8)
-                self._rows_learned = True
+            if c:   # adapt this table's estimate to its real schema width
+                per_row = max(self._rows_bytes(rows), 8)
+                with self._lock:
+                    self._row_bytes_est[tname] = per_row
             parts.append(rows)
             lo += c
             if lo >= len(keys):

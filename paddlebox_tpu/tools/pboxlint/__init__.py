@@ -1,0 +1,31 @@
+"""pboxlint — AST-based static analysis for this codebase's invariants.
+
+The reference PaddleBox system hardens its C++ hot paths with
+compiler-enforced invariants (PADDLE_ENFORCE, the gflags registry, guarded
+BoxPS lifecycle).  The Python/JAX rebuild gets none of that from the
+interpreter, so this package supplies the equivalent as lint passes over
+`ast`, one small visitor per rule family:
+
+  PB1xx  lock discipline        (tools/pboxlint/locks.py)
+  PB2xx  flag hygiene           (tools/pboxlint/flags_hygiene.py)
+  PB3xx  JAX purity             (tools/pboxlint/purity.py)
+  PB4xx  threading lifecycle    (tools/pboxlint/lifecycle.py)
+
+CLI::
+
+    python -m paddlebox_tpu.tools.pboxlint paddlebox_tpu/
+
+emits ``file:line: PBnnn message`` per finding and exits nonzero when any
+survive suppression.  Suppress a deliberate exception precisely::
+
+    risky_line()            # pboxlint: disable=PB102 -- justification
+    # pboxlint: disable-next=PB102 -- justification
+    risky_line()
+
+Tier-1 runs the whole-package gate (tests/test_pboxlint.py) and asserts
+zero findings, so the analyzer and the tree stay clean together.
+"""
+
+from paddlebox_tpu.tools.pboxlint.core import (  # noqa: F401
+    Finding, Module, PackageContext, lint_modules, lint_paths, lint_source,
+    ALL_CHECKERS)

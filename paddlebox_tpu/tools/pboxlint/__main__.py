@@ -1,0 +1,8 @@
+"""`python -m paddlebox_tpu.tools.pboxlint <file-or-dir> [...]`."""
+
+import sys
+
+from paddlebox_tpu.tools.pboxlint.core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+"""pboxlint runner: module model, suppressions, checker registry, CLI core.
+
+Stdlib-only (`ast` + `re`) so the linter can run in any environment the
+package imports in — including the tier-1 gate — with no extra deps.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# `# pboxlint: disable=PB101,PB102 -- why` (same line) or
+# `# pboxlint: disable-next=PB101 -- why` (line above the finding).
+_SUPPRESS_RE = re.compile(
+    r"#\s*pboxlint:\s*disable(?P<next>-next)?"
+    r"(?:\s*=\s*(?P<codes>[A-Za-z0-9_,\s]+))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+class Module:
+    """One parsed source file + its suppression table."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        # line -> set of suppressed codes ("ALL" suppresses everything)
+        self.suppressions: Dict[int, Set[str]] = {}
+        for lineno, text in enumerate(source.splitlines(), 1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            codes = m.group("codes")
+            target = lineno + 1 if m.group("next") else lineno
+            parsed = ({c.strip().upper()
+                       for c in re.split(r"[,\s]+", codes) if c.strip()}
+                      if codes else {"ALL"})
+            self.suppressions.setdefault(target, set()).update(parsed)
+
+    @property
+    def basename(self) -> str:
+        return os.path.basename(self.path)
+
+    def suppressed(self, finding: Finding) -> bool:
+        codes = self.suppressions.get(finding.line)
+        return bool(codes) and ("ALL" in codes or finding.code in codes)
+
+
+class PackageContext:
+    """Cross-module state shared by every checker (e.g. the flag registry
+    built from all `define_flag` call sites in the linted set)."""
+
+    def __init__(self, modules: Sequence[Module]):
+        self.modules = list(modules)
+        self.defined_flags: Set[str] = set()
+        self.dynamic_flag_defs = False    # define_flag with non-literal name
+        for mod in self.modules:
+            for node in ast.walk(mod.tree):
+                if (isinstance(node, ast.Call)
+                        and _call_name(node).endswith("define_flag")
+                        and node.args):
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str):
+                        self.defined_flags.add(arg.value)
+                    else:
+                        self.dynamic_flag_defs = True
+
+
+def _call_name(node: ast.Call) -> str:
+    """Dotted name of a call target ('' when not a plain name chain)."""
+    return dotted_name(node.func)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """`a.b.c` → "a.b.c"; anything non-name-chain contributes ""."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def ALL_CHECKERS():
+    # local import: checker modules import core for helpers
+    from paddlebox_tpu.tools.pboxlint import (flags_hygiene, lifecycle,
+                                              locks, purity)
+    return (locks.check, flags_hygiene.check, purity.check, lifecycle.check)
+
+
+def lint_modules(modules: Sequence[Module]) -> List[Finding]:
+    ctx = PackageContext(modules)
+    findings: List[Finding] = []
+    for mod in modules:
+        for check in ALL_CHECKERS():
+            findings.extend(f for f in check(mod, ctx)
+                            if not mod.suppressed(f))
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+def lint_paths(paths: Sequence[str]
+               ) -> Tuple[List[Finding], List[Tuple[str, str]]]:
+    """→ (findings, [(path, parse-error)])."""
+    modules: List[Module] = []
+    errors: List[Tuple[str, str]] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            modules.append(Module(path, src))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append((path, repr(e)))
+    return lint_modules(modules), errors
+
+
+def lint_source(source: str, path: str = "<snippet>",
+                extra: Optional[Sequence[Module]] = None) -> List[Finding]:
+    """Lint one source string (unit-test surface for checker snippets)."""
+    mods = [Module(path, source)] + list(extra or [])
+    return [f for f in lint_modules(mods) if f.path == path]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args or "-h" in args or "--help" in args:
+        print(__doc__)
+        print("usage: python -m paddlebox_tpu.tools.pboxlint "
+              "<file-or-dir> [...]")
+        return 0 if args else 2
+    findings, errors = lint_paths(args)
+    for path, err in errors:
+        print(f"{path}:0: PB000 parse failure: {err}")
+    for f in findings:
+        print(f.render())
+    if errors:
+        return 2
+    if findings:
+        print(f"pboxlint: {len(findings)} finding(s)")
+        return 1
+    return 0

@@ -1,0 +1,123 @@
+"""PB2xx — flag hygiene (the gflags-registry discipline, flags.py).
+
+  PB201  ``get_flags("name")`` / ``set_flags({"name": ...})`` references a
+         flag never registered via ``define_flag`` anywhere in the linted
+         set — a typo'd name raises KeyError at runtime, possibly deep in
+         a pass loop.
+  PB202  a ``define_flag`` default cannot round-trip through ``_coerce``
+         (the ``FLAGS_<name>`` env-override parser): non-scalar defaults
+         or values whose str() form parses back differently would make
+         env overrides silently diverge from programmatic sets.
+  PB203  raw ``os.environ["FLAGS_..."]`` / ``os.getenv("FLAGS_...")``
+         access outside flags.py — bypasses the registry (no defaults, no
+         coercion, no set_flags visibility).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, List, Optional
+
+from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
+                                               PackageContext, dotted_name)
+
+
+def _coerce_roundtrips(default: Any) -> bool:
+    """Mirror flags._coerce: env text is parsed by the *default's* type."""
+    try:
+        if isinstance(default, bool):
+            return (str(default).lower() in ("1", "true", "yes", "on")) \
+                == default
+        if isinstance(default, int):
+            return int(str(default)) == default
+        if isinstance(default, float):
+            return float(str(default)) == default
+        return isinstance(default, str)
+    except (TypeError, ValueError):
+        return False
+
+
+def _literal(node: ast.AST) -> Optional[Any]:
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def check(mod: Module, ctx: PackageContext) -> List[Finding]:
+    findings: List[Finding] = []
+    is_flags_module = mod.basename == "flags.py"
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        tail = name.rsplit(".", 1)[-1]
+
+        if tail == "get_flags" and node.args:
+            arg = node.args[0]
+            if (isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+                    and arg.value not in ctx.defined_flags
+                    and not ctx.dynamic_flag_defs):
+                findings.append(Finding(
+                    mod.path, node.lineno, "PB201",
+                    f"get_flags({arg.value!r}) but no define_flag registers "
+                    f"that name anywhere in the linted set — KeyError at "
+                    f"runtime"))
+
+        elif tail == "set_flags" and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Dict) and not ctx.dynamic_flag_defs:
+                for k in arg.keys:
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)
+                            and k.value not in ctx.defined_flags):
+                        findings.append(Finding(
+                            mod.path, k.lineno, "PB201",
+                            f"set_flags key {k.value!r} is not a registered "
+                            f"flag — KeyError at runtime"))
+
+        elif tail == "define_flag" and len(node.args) >= 2:
+            default_node = node.args[1]
+            default = _literal(default_node)
+            if default is None and not (
+                    isinstance(default_node, ast.Constant)
+                    and default_node.value is None):
+                continue        # non-literal default: out of static reach
+            if not _coerce_roundtrips(default):
+                fname = (node.args[0].value
+                         if isinstance(node.args[0], ast.Constant) else "?")
+                findings.append(Finding(
+                    mod.path, node.lineno, "PB202",
+                    f"define_flag({fname!r}) default {default!r} "
+                    f"({type(default).__name__}) does not round-trip "
+                    f"through _coerce — a FLAGS_ env override would "
+                    f"diverge from the programmatic value"))
+
+        elif not is_flags_module:
+            key_node: Optional[ast.AST] = None
+            if name == "os.getenv" and node.args:
+                key_node = node.args[0]
+            elif (name == "os.environ.get" and node.args):
+                key_node = node.args[0]
+            if (isinstance(key_node, ast.Constant)
+                    and isinstance(key_node.value, str)
+                    and key_node.value.startswith("FLAGS_")):
+                findings.append(Finding(
+                    mod.path, node.lineno, "PB203",
+                    f"raw environment read of {key_node.value!r} outside "
+                    f"flags.py — use get_flags() so defaults/coercion/"
+                    f"set_flags apply"))
+
+    if not is_flags_module:
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Subscript)
+                    and dotted_name(node.value) == "os.environ"
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                    and node.slice.value.startswith("FLAGS_")):
+                findings.append(Finding(
+                    mod.path, node.lineno, "PB203",
+                    f"raw environment read of {node.slice.value!r} outside "
+                    f"flags.py — use get_flags() so defaults/coercion/"
+                    f"set_flags apply"))
+    return findings

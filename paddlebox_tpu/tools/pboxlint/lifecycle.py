@@ -1,0 +1,205 @@
+"""PB4xx — threading lifecycle.
+
+  PB401  ``threading.Thread(...)`` created without an explicit ``daemon=``
+         and never ``.join()``-ed in its owning scope (the enclosing class
+         for ``self.X`` threads, the enclosing function for locals) — on
+         interpreter shutdown a forgotten non-daemon thread hangs the
+         process; a daemon-less *joined* thread is a deliberate lifecycle.
+  PB402  a blocking ``Queue.get()`` / ``Channel.get()`` (no timeout) in a
+         ``while`` loop whose body has neither a sentinel escape
+         (``break``/``return``) nor an exception handler — the consumer
+         hang class seen in channel/pass-feed code: the producer dies, the
+         loop blocks forever.
+
+Queue-typed receivers are recognized syntactically: any name (local or
+``self.X``) assigned from a ``queue.Queue``-family constructor or from a
+``Channel(...)`` call anywhere in the module.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
+                                               PackageContext, dotted_name)
+
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                "Channel"}
+
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return name in ("threading.Thread", "Thread")
+
+
+def _has_daemon_kw(call: ast.Call) -> bool:
+    return any(kw.arg == "daemon" for kw in call.keywords)
+
+
+def _target_name(target: ast.AST) -> Tuple[Optional[str], bool]:
+    """→ (name, is_self_attr); (None, False) when not a simple target."""
+    if isinstance(target, ast.Name):
+        return target.id, False
+    if (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id in ("self", "cls")):
+        return target.attr, True
+    return None, False
+
+
+def _method_calls_on(scope: ast.AST, method: str) -> Set[Tuple[str, bool]]:
+    """Receivers of `<recv>.<method>(...)` in scope → {(name, is_self)}."""
+    out: Set[Tuple[str, bool]] = set()
+    for node in ast.walk(scope):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == method):
+            name, is_self = _target_name(node.func.value)
+            if name is not None:
+                out.add((name, is_self))
+    return out
+
+
+def _daemon_assigns(scope: ast.AST) -> Set[Tuple[str, bool]]:
+    """Receivers of `<recv>.daemon = ...` in scope."""
+    out: Set[Tuple[str, bool]] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                    name, is_self = _target_name(t.value)
+                    if name is not None:
+                        out.add((name, is_self))
+    return out
+
+
+def _check_threads(mod: Module) -> List[Finding]:
+    findings: List[Finding] = []
+    # owning scope for a `self.X` thread is its innermost class; for a
+    # local, the innermost function (module body otherwise).
+    parent = {}
+    for node in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(node):
+            parent[child] = node
+
+    def owning_scope(node: ast.AST, want_class: bool) -> ast.AST:
+        cur = parent.get(node)
+        while cur is not None:
+            if want_class and isinstance(cur, ast.ClassDef):
+                return cur
+            if not want_class and isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = parent.get(cur)
+        return mod.tree
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and _is_thread_ctor(node.value):
+            call = node.value
+            for name, is_self in map(_target_name, node.targets):
+                if name is None:
+                    continue
+                scope = owning_scope(node, want_class=is_self)
+                if (_has_daemon_kw(call)
+                        or (name, is_self) in _daemon_assigns(scope)
+                        or (name, is_self) in _method_calls_on(scope,
+                                                               "join")):
+                    continue
+                findings.append(Finding(
+                    mod.path, call.lineno, "PB401",
+                    f"thread {name!r} is started without an explicit "
+                    f"daemon= and never joined in its owning scope — a "
+                    f"forgotten non-daemon thread hangs interpreter "
+                    f"shutdown"))
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            # bare `threading.Thread(...).start()` — nothing to join
+            inner = node.value
+            if (isinstance(inner.func, ast.Attribute)
+                    and inner.func.attr == "start"
+                    and _is_thread_ctor(inner.func.value)
+                    and not _has_daemon_kw(inner.func.value)):
+                findings.append(Finding(
+                    mod.path, inner.lineno, "PB401",
+                    "anonymous thread started without an explicit "
+                    "daemon= — it can never be joined and a non-daemon "
+                    "default hangs interpreter shutdown"))
+    return findings
+
+
+def _queue_names(mod: Module) -> Set[str]:
+    """Names (attr or local, unqualified) assigned from a queue ctor
+    anywhere in the module."""
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, (ast.Assign, ast.AnnAssign))
+                and node.value is not None
+                and isinstance(node.value, ast.Call)):
+            continue
+        ctor = dotted_name(node.value.func).rsplit(".", 1)[-1]
+        if ctor not in _QUEUE_CTORS:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            name, _ = _target_name(t)
+            if name is not None:
+                out.add(name)
+    return out
+
+
+def _loop_has_escape(loop: ast.While) -> bool:
+    """break / return / raise anywhere in the loop body (not counting
+    nested loops' own breaks — close enough for a lint heuristic)."""
+    for node in ast.walk(loop):
+        if isinstance(node, (ast.Break, ast.Return, ast.Raise)):
+            return True
+    return False
+
+
+def _in_try_with_handler(loop: ast.While, get_call: ast.Call) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Try) and node.handlers:
+            if any(n is get_call for n in ast.walk(node)):
+                return True
+    return False
+
+
+def _check_queue_gets(mod: Module) -> List[Finding]:
+    queues = _queue_names(mod)
+    if not queues:
+        return []
+    findings: List[Finding] = []
+    for loop in ast.walk(mod.tree):
+        if not isinstance(loop, ast.While):
+            continue
+        for node in ast.walk(loop):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and not node.args and not node.keywords):
+                continue
+            recv, _ = _target_name(node.func.value)
+            if recv not in queues:
+                continue
+            if _loop_has_escape(loop) or _in_try_with_handler(loop, node):
+                continue
+            # a loop gated on the queue's own state (`while q.size():`)
+            # only calls get() when an item is present — not the hang class
+            if any(_target_name(n)[0] == recv
+                   for n in ast.walk(loop.test)
+                   if isinstance(n, (ast.Attribute, ast.Name))):
+                continue
+            findings.append(Finding(
+                mod.path, node.lineno, "PB402",
+                f"blocking {recv}.get() with no timeout in a loop with no "
+                f"break/return and no exception handler — if the producer "
+                f"dies this consumer hangs forever; add a timeout or a "
+                f"sentinel escape"))
+    return findings
+
+
+def check(mod: Module, ctx: PackageContext) -> List[Finding]:
+    return _check_threads(mod) + _check_queue_gets(mod)

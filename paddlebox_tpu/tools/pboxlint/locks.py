@@ -1,0 +1,347 @@
+"""PB1xx — lock discipline.
+
+For every class that creates a ``threading.Lock/RLock/Condition``
+attribute:
+
+  PB101  an instance attribute is mutated both inside and outside
+         ``with self.<lock>:`` blocks — the guard is advisory only.
+  PB102  a lock-adjacent method (one that acquires a class lock directly,
+         or transitively through other methods of the class) reads an
+         instance attribute and later mutates it, with BOTH accesses
+         outside any lock block — the check-then-act / read-modify-write
+         race class (the pre-fix ps/service.py pull_sparse estimate bug).
+  PB103  a lock acquired via ``.acquire()`` whose release is not
+         protected by ``try/finally`` — an exception leaks the lock.
+
+Scope notes (deliberate):
+  * ``__init__``/``__new__`` bodies — and private helpers called only
+    from them — run before the instance is shared; their accesses count
+    as neither inside nor outside.
+  * Nested function bodies (thread targets, callbacks) execute on their
+    own schedule, typically sequenced by start/join, so they are skipped
+    for PB102; their writes still count for PB101.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
+                                               PackageContext, dotted_name)
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_MUTATOR_METHODS = {"append", "extend", "insert", "add", "update",
+                    "setdefault", "pop", "popleft", "popitem", "remove",
+                    "discard", "clear", "sort", "reverse"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return name.rsplit(".", 1)[-1] in _LOCK_FACTORIES and (
+        "." not in name or name.startswith("threading."))
+
+
+def _contains_lock_ctor(node: ast.AST) -> bool:
+    return any(_is_lock_ctor(n) for n in ast.walk(node))
+
+
+def _self_attr(node: ast.AST, self_name: str) -> Optional[str]:
+    """`self.X`, `self.X[...]` (any subscript depth) → "X"."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == self_name):
+        return node.attr
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Per-method access log: (attr, line, inside-lock?) reads/writes,
+    direct lock acquisition, and intra-class call edges."""
+
+    def __init__(self, self_name: str, lock_attrs: Set[str]):
+        self.self_name = self_name
+        self.lock_attrs = lock_attrs
+        # entries: (attr, line, inside_lock, in_nested_def)
+        self.reads: List[Tuple[str, int, bool, bool]] = []
+        self.writes: List[Tuple[str, int, bool, bool]] = []
+        self.acquires = False
+        self.calls: Set[str] = set()
+        self._depth = 0          # >0 → inside a lock-guarded with block
+        self._fn_depth = 0       # >0 → inside a nested def/lambda
+
+    # -- lock context --------------------------------------------------------
+    def _is_lock_expr(self, node: ast.AST) -> bool:
+        attr = _self_attr(node, self.self_name)
+        return attr is not None and attr in self.lock_attrs
+
+    def visit_With(self, node: ast.With) -> None:
+        guarded = any(self._is_lock_expr(item.context_expr)
+                      for item in node.items)
+        for item in node.items:
+            self.visit(item.context_expr)
+        if guarded:
+            self._depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if guarded:
+            self._depth -= 1
+
+    # -- nested scopes -------------------------------------------------------
+    def visit_FunctionDef(self, node) -> None:
+        self._fn_depth += 1
+        self.generic_visit(node)
+        self._fn_depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    # -- accesses ------------------------------------------------------------
+    def _record_write(self, target: ast.AST) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_write(elt)
+            return
+        if isinstance(target, ast.Starred):
+            self._record_write(target.value)
+            return
+        attr = _self_attr(target, self.self_name)
+        if attr is not None:
+            self.writes.append((attr, target.lineno, self._depth > 0,
+                                self._fn_depth > 0))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record_write(t)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_write(node.target)
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        attr = _self_attr(node.target, self.self_name)
+        if attr is not None:
+            inside = self._depth > 0
+            nested = self._fn_depth > 0
+            self.reads.append((attr, node.lineno, inside, nested))
+            self.writes.append((attr, node.lineno, inside, nested))
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                self._record_write(t)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            recv = _self_attr(node.func.value, self.self_name)
+            if recv is not None:
+                if node.func.attr in ("acquire",) and recv in self.lock_attrs:
+                    self.acquires = True
+                elif node.func.attr in _MUTATOR_METHODS:
+                    # container mutation through a method call is a write
+                    self.writes.append((recv, node.lineno,
+                                        self._depth > 0,
+                                        self._fn_depth > 0))
+            if isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == self.self_name:
+                self.calls.add(node.func.attr)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.ctx, ast.Load):
+            attr = _self_attr(node, self.self_name)
+            if attr is not None:
+                self.reads.append((attr, node.lineno, self._depth > 0,
+                                   self._fn_depth > 0))
+        self.generic_visit(node)
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.methods: Dict[str, ast.FunctionDef] = {
+            m.name: m for m in node.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.lock_attrs = self._find_lock_attrs()
+        self.scans: Dict[str, _MethodScan] = {}
+        for name, m in self.methods.items():
+            scan = _MethodScan(self._self_name(m), self.lock_attrs)
+            for stmt in m.body:
+                scan.visit(stmt)
+            self.scans[name] = scan
+
+    @staticmethod
+    def _self_name(m: ast.FunctionDef) -> str:
+        return m.args.args[0].arg if m.args.args else "self"
+
+    def _find_lock_attrs(self) -> Set[str]:
+        locks: Set[str] = set()
+        # class-level: `_instance_lock = threading.Lock()`
+        for stmt in self.node.body:
+            if isinstance(stmt, ast.Assign) \
+                    and _contains_lock_ctor(stmt.value):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        locks.add(t.id)
+        # instance-level: `self.X = threading.Lock()` (incl. containers
+        # of locks, e.g. `{name: threading.Lock() for ...}`)
+        for m in self.methods.values():
+            self_name = self._self_name(m)
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) \
+                        and _contains_lock_ctor(node.value):
+                    for t in node.targets:
+                        attr = _self_attr(t, self_name)
+                        if attr is not None:
+                            locks.add(attr)
+        return locks
+
+    def init_only_methods(self) -> Set[str]:
+        """__init__/__new__ plus private helpers reachable only from them
+        (index builders etc. that run before the instance is shared)."""
+        base = {"__init__", "__new__"}
+        callers: Dict[str, Set[str]] = {name: set() for name in self.methods}
+        for name, scan in self.scans.items():
+            for callee in scan.calls:
+                if callee in callers:
+                    callers[callee].add(name)
+        out = set(base)
+        changed = True
+        while changed:
+            changed = False
+            for name, who in callers.items():
+                if (name not in out and name.startswith("_")
+                        and not name.startswith("__") and who
+                        and who <= out):
+                    out.add(name)
+                    changed = True
+        return out
+
+    def lock_adjacent_methods(self) -> Set[str]:
+        """Methods that acquire a class lock directly or via intra-class
+        calls (transitive closure over `self.m()` edges)."""
+        adjacent = {name for name, scan in self.scans.items()
+                    if self._has_lock_with(name)}
+        changed = True
+        while changed:
+            changed = False
+            for name, scan in self.scans.items():
+                if name not in adjacent and scan.calls & adjacent:
+                    adjacent.add(name)
+                    changed = True
+        return adjacent
+
+    def _has_lock_with(self, name: str) -> bool:
+        scan = self.scans[name]
+        m = self.methods[name]
+        if scan.acquires:
+            return True
+        self_name = self._self_name(m)
+        for node in ast.walk(m):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr, self_name)
+                    if attr in self.lock_attrs:
+                        return True
+        return False
+
+
+def _check_class(mod: Module, cls: ast.ClassDef) -> List[Finding]:
+    info = _ClassInfo(cls)
+    if not info.lock_attrs:
+        return []
+    findings: List[Finding] = []
+    init_only = info.init_only_methods()
+    adjacent = info.lock_adjacent_methods() - init_only
+
+    # PB101: per-attribute inside+outside mutation
+    writes_in: Dict[str, List[Tuple[str, int]]] = {}
+    writes_out: Dict[str, List[Tuple[str, int]]] = {}
+    for name, scan in info.scans.items():
+        if name in init_only:
+            continue
+        for attr, line, inside, _nested in scan.writes:
+            if attr in info.lock_attrs:
+                continue
+            (writes_in if inside else writes_out).setdefault(
+                attr, []).append((name, line))
+    for attr in sorted(set(writes_in) & set(writes_out)):
+        for name, line in sorted(writes_out[attr], key=lambda t: t[1]):
+            findings.append(Finding(
+                mod.path, line, "PB101",
+                f"{cls.name}.{attr} is mutated here outside the lock but "
+                f"under it elsewhere (e.g. line "
+                f"{min(l for _, l in writes_in[attr])}) — move this "
+                f"mutation under the lock"))
+
+    # PB102: unlocked read-modify-write in lock-adjacent methods
+    for name in sorted(adjacent):
+        scan = info.scans[name]
+        out_reads: Dict[str, int] = {}
+        for attr, line, inside, nested in scan.reads:
+            if not inside and not nested \
+                    and attr not in info.lock_attrs:
+                out_reads.setdefault(attr, line)
+        flagged: Set[str] = set()
+        for attr, line, inside, nested in scan.writes:
+            if (inside or nested or attr in info.lock_attrs
+                    or attr in flagged or attr not in out_reads
+                    or line < out_reads[attr]):
+                continue
+            flagged.add(attr)
+            findings.append(Finding(
+                mod.path, line, "PB102",
+                f"{cls.name}.{name} reads {attr} (line {out_reads[attr]}) "
+                f"and mutates it here without holding the class lock — a "
+                f"concurrent caller interleaves between check and act"))
+    return findings
+
+
+def _check_bare_acquire(mod: Module) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        body_lists = [getattr(node, f, None)
+                      for f in ("body", "orelse", "finalbody")]
+        for body in body_lists:
+            if not isinstance(body, list):
+                continue
+            for i, stmt in enumerate(body):
+                if not (isinstance(stmt, ast.Expr)
+                        and isinstance(stmt.value, ast.Call)
+                        and isinstance(stmt.value.func, ast.Attribute)
+                        and stmt.value.func.attr == "acquire"):
+                    continue
+                recv = ast.dump(stmt.value.func.value)
+                nxt = body[i + 1] if i + 1 < len(body) else None
+                ok = False
+                if isinstance(nxt, ast.Try) and nxt.finalbody:
+                    for n in ast.walk(ast.Module(body=nxt.finalbody,
+                                                 type_ignores=[])):
+                        if (isinstance(n, ast.Call)
+                                and isinstance(n.func, ast.Attribute)
+                                and n.func.attr == "release"
+                                and ast.dump(n.func.value) == recv):
+                            ok = True
+                if not ok:
+                    findings.append(Finding(
+                        mod.path, stmt.lineno, "PB103",
+                        "lock.acquire() without an immediately following "
+                        "try/finally release — an exception leaks the "
+                        "lock; prefer `with lock:`"))
+    return findings
+
+
+def check(mod: Module, ctx: PackageContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_check_class(mod, node))
+    findings.extend(_check_bare_acquire(mod))
+    return findings

@@ -1,0 +1,167 @@
+"""PB3xx — JAX purity inside traced functions.
+
+A function is *traced* when it is decorated with ``jax.jit`` (directly or
+through ``partial(jax.jit, ...)``), wrapped by a ``jax.jit(fn)`` call, or
+passed by name into a tracing combinator (``lax.scan`` / ``while_loop`` /
+``cond`` / ``fori_loop`` / ``switch`` / ``map`` / ``jax.pmap``).  Inside a
+traced function:
+
+  PB301  host-synchronizing / side-effecting calls: ``float()``,
+         ``int()``, ``bool()``, ``.item()``, ``np.asarray``/``np.array``,
+         ``print``, ``get_flags``, ``jax.device_get`` — they either force
+         a device→host sync mid-trace, bake a trace-time value into the
+         compiled program (silently stale after retrace), or spam once
+         per trace instead of per step.
+  PB302  attribute mutation on ``self`` (or any argument) — the write
+         happens once at trace time, not per step; the compiled program
+         never sees it again.
+
+Nested functions defined inside a traced function execute during the
+trace, so they are walked too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
+                                               PackageContext, dotted_name)
+
+_TRACING_CALLS = {"jit", "pmap", "scan", "while_loop", "cond", "fori_loop",
+                  "switch", "map", "associative_scan"}
+_TRACING_ROOTS = ("jax", "lax", "jax.lax")
+_HOST_BUILTINS = {"float", "int", "bool", "print"}
+_NP_DENY = {"asarray", "array", "frombuffer", "fromiter", "copyto",
+            "ascontiguousarray", "save", "savez", "load"}
+
+
+def _is_tracing_callable(name: str) -> bool:
+    if not name:
+        return False
+    head, _, tail = name.rpartition(".")
+    return tail in _TRACING_CALLS and (head in _TRACING_ROOTS or not head
+                                       and tail == "jit")
+
+
+def _is_jit_reference(node: ast.AST) -> bool:
+    """`jax.jit`, `jit`, or `partial(jax.jit, ...)` as an expression."""
+    name = dotted_name(node)
+    if name in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname.rsplit(".", 1)[-1] == "partial":
+            return any(_is_jit_reference(a) for a in node.args)
+        # decorator form `jax.jit(...)` / `lax-free jit(...)`
+        return _is_jit_reference(node.func)
+    return False
+
+
+def _collect_traced(mod: Module) -> List[ast.AST]:
+    """Function nodes whose bodies execute under tracing."""
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    traced: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def mark(node: ast.AST) -> None:
+        if id(node) not in seen:
+            seen.add(id(node))
+            traced.append(node)
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_reference(d) for d in node.decorator_list):
+                mark(node)
+        elif isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if not (_is_tracing_callable(fname)
+                    or _is_jit_reference(node.func)):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    for d in defs_by_name.get(arg.id, ()):
+                        mark(d)
+                elif isinstance(arg, ast.Lambda):
+                    mark(arg)
+    return traced
+
+
+def _first_param(fn: ast.AST) -> str:
+    args = getattr(fn, "args", None)
+    if args and args.args:
+        return args.args[0].arg
+    return ""
+
+
+def check(mod: Module, ctx: PackageContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in _collect_traced(mod):
+        fn_name = getattr(fn, "name", "<lambda>")
+        self_name = _first_param(fn)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        # a param rebound to a fresh local (`ws = dict(ws)`) is a copy —
+        # mutating the copy is the idiomatic functional-update pattern,
+        # not trace-time state mutation
+        rebind_line = None
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == self_name
+                        for t in node.targets):
+                    rebind_line = (node.lineno if rebind_line is None
+                                   else min(rebind_line, node.lineno))
+        for node in [n for stmt in body for n in ast.walk(stmt)]:
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                tail = name.rsplit(".", 1)[-1]
+                root = name.split(".", 1)[0]
+                msg = None
+                if name in _HOST_BUILTINS:
+                    msg = (f"{name}() on a traced value forces a "
+                           f"device→host sync (or bakes a trace-time "
+                           f"constant into the compiled program)")
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"):
+                    msg = ".item() forces a device→host sync inside the " \
+                          "traced function"
+                elif root in ("np", "numpy") and tail in _NP_DENY:
+                    msg = (f"{name}() materializes a host array mid-trace "
+                           f"— use jnp, or hoist the host work out of the "
+                           f"traced function")
+                elif tail == "get_flags":
+                    msg = ("get_flags() inside a traced function bakes the "
+                           "flag's trace-time value into the compiled "
+                           "program — read it at build time and close over "
+                           "it")
+                elif name in ("jax.device_get",):
+                    msg = "jax.device_get() mid-trace forces a host sync"
+                if msg is not None:
+                    findings.append(Finding(
+                        mod.path, node.lineno, "PB301",
+                        f"in traced function {fn_name!r}: {msg}"))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)) \
+                    and self_name:
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    base = t
+                    while isinstance(base, (ast.Subscript, ast.Attribute)):
+                        base = base.value
+                    is_attr_or_item = isinstance(
+                        t, (ast.Attribute, ast.Subscript))
+                    if (is_attr_or_item and isinstance(base, ast.Name)
+                            and base.id == self_name
+                            and not (rebind_line is not None
+                                     and rebind_line <= t.lineno)):
+                        findings.append(Finding(
+                            mod.path, t.lineno, "PB302",
+                            f"in traced function {fn_name!r}: mutation of "
+                            f"{self_name!r} state happens once at trace "
+                            f"time, not per step — return the new value "
+                            f"instead"))
+    return findings

@@ -5,9 +5,13 @@ step progress against a SHARED job checkpoint (rank 0 persists it, every
 generation resumes from it — the stand-in for io/checkpoint auto-resume),
 and can fault-inject at step 3 of generation 0:
 
-  kill       — rank 1 SIGKILLs itself ("node" loss -> scale-in)
-  partition  — rank 1 stops heartbeating but stays alive (network
-               partition -> the launcher must SIGTERM it and scale in)
+  kill         — rank 1 SIGKILLs itself once, in generation 0 (a
+                 transient OOM kill -> the launcher must respawn it, not
+                 scale in)
+  kill_repeat  — rank 1 SIGKILLs itself in generations 0 AND 1 (repeat
+                 SIGKILL from the same rank -> real node loss: scale-in)
+  partition    — rank 1 stops heartbeating but stays alive (network
+                 partition -> the launcher must SIGTERM it and scale in)
 
 On completion each rank writes ``done-g{gen}-r{rank}`` so the test can
 assert which generation/world finished the job.
@@ -50,10 +54,11 @@ def main():
         # fault-inject on the LOCAL iteration count: the shared checkpoint
         # advances while this rank is still importing, so a global-step
         # trigger could be skipped entirely on a slow-starting rank
-        if gen == 0 and rank == 1 and it == 3:
-            if mode == "kill":
+        if rank == 1 and it == 3:
+            kill_gens = {"kill": (0,), "kill_repeat": (0, 1)}.get(mode, ())
+            if gen in kill_gens:
                 os.kill(os.getpid(), signal.SIGKILL)
-            if mode == "partition":
+            if mode == "partition" and gen == 0:
                 em.stop()               # heartbeat goes silent, process
                 time.sleep(120)         # lingers until the launcher acts
         it += 1

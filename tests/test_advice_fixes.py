@@ -284,7 +284,8 @@ def test_python_fallback_rejects_overflow_like_native(tmp_path, monkeypatch):
     def fresh():
         return BoxPSEngine(EmbeddingTableConfig(
             embedding_dim=2, shard_num=2,
-            sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0)),
+            mode="serving")
 
     ok = str(tmp_path / "sub.txt")
     with open(ok, "w") as f:
@@ -317,7 +318,8 @@ def test_xbox_parsers_agree_on_inf_nan_and_line_numbers(tmp_path):
     def fresh():
         return BoxPSEngine(EmbeddingTableConfig(
             embedding_dim=2, shard_num=2,
-            sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0)),
+            mode="serving")
 
     inf_file = str(tmp_path / "inf.txt")
     with open(inf_file, "w") as f:
@@ -362,7 +364,8 @@ def test_xbox_parsers_agree_on_whitespace_lines_and_negative_keys(tmp_path):
     def fresh():
         return BoxPSEngine(EmbeddingTableConfig(
             embedding_dim=2, shard_num=2,
-            sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0)),
+            mode="serving")
 
     ws_file = str(tmp_path / "ws.txt")
     with open(ws_file, "w") as f:

@@ -70,7 +70,7 @@ def test_xbox_serving_roundtrip(data_file, tmp_path):
 
     srv = BoxPSEngine(EmbeddingTableConfig(
         embedding_dim=engine.config.embedding_dim, shard_num=4,
-        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)), mode="serving")
     keys = load_xbox(srv, path)
     assert len(keys) == n
     srv.begin_feed_pass()
@@ -116,7 +116,7 @@ def test_load_xbox_base_plus_delta_last_wins(tmp_path):
         f.write("7\t5\t2\t0.9\t0.7 0.8\n")     # delta overrides key 7
     eng = BoxPSEngine(EmbeddingTableConfig(
         embedding_dim=2, shard_num=2,
-        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)), mode="serving")
     keys = load_xbox(eng, path)
     assert sorted(keys.tolist()) == [7, 9]
     rows = eng.table.bulk_pull(np.array([7, 9], np.uint64))
@@ -163,7 +163,8 @@ def test_native_load_matches_python_fallback(data_file, tmp_path,
     def fresh():
         return BoxPSEngine(EmbeddingTableConfig(
             embedding_dim=engine.config.embedding_dim, shard_num=4,
-            sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0)),
+            mode="serving")
 
     # a malformed line fails loud with its index (native parser)
     bad = str(tmp_path / "bad.txt")
@@ -173,7 +174,8 @@ def test_native_load_matches_python_fallback(data_file, tmp_path,
     with pytest.raises(ValueError, match="malformed xbox line 2"):
         load_xbox(BoxPSEngine(EmbeddingTableConfig(
             embedding_dim=2, shard_num=2,
-            sgd=SparseSGDConfig(mf_create_thresholds=0.0))), bad)
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0)),
+            mode="serving"), bad)
 
     e_native = fresh()
     k1 = load_xbox(e_native, path)
@@ -186,3 +188,35 @@ def test_native_load_matches_python_fallback(data_file, tmp_path,
     b = e_py.table.bulk_pull(probe)
     for fld in ("show", "click", "embed_w", "mf", "mf_size"):
         np.testing.assert_array_equal(a[fld], b[fld], err_msg=fld)
+
+
+def test_load_xbox_warns_on_training_mode_engine(tmp_path):
+    """load_xbox is a serving-only loader: mf_size is re-derived as
+    any(mf != 0), so a created all-zero embedx row round-trips as
+    uncreated.  A training-mode engine gets warned and steered to
+    load_checkpoint (TrainCheckpoint.resume); a serving-mode engine
+    loads silently."""
+    import warnings
+
+    from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+    from paddlebox_tpu.io.checkpoint import load_xbox
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+
+    path = str(tmp_path / "x.txt")
+    with open(path, "w") as f:
+        f.write("7\t1\t0\t0.5\t0.1 0.2\n")
+
+    def cfg():
+        return EmbeddingTableConfig(
+            embedding_dim=2, shard_num=2,
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0))
+
+    with pytest.warns(UserWarning, match="load_checkpoint"):
+        keys = load_xbox(BoxPSEngine(cfg()), path)     # default: train
+    assert keys.tolist() == [7]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")                 # no warning allowed
+        keys = load_xbox(BoxPSEngine(cfg(), mode="serving"), path)
+    assert keys.tolist() == [7]
+    with pytest.raises(ValueError, match="mode"):
+        BoxPSEngine(cfg(), mode="predict")

@@ -114,7 +114,7 @@ def test_full_day_workflow(data_file, tmp_path):
     # -- serving handoff -----------------------------------------------
     srv = BoxPSEngine(EmbeddingTableConfig(
         embedding_dim=MF_DIM, shard_num=4,
-        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)), mode="serving")
     keys = load_xbox(srv, xbox_path)
     assert len(keys) == n_xbox
     srv.begin_feed_pass()

@@ -104,21 +104,41 @@ def _read_json(path):
         return json.load(f)
 
 
-def test_elastic_relaunch_shrinks_world_after_node_loss(tmp_path):
-    """Rank 1 SIGKILLs itself mid-pass: the launcher re-rendezvouses into
-    a 2-worker generation 1, the job resumes from the shared checkpoint
+def test_elastic_relaunch_shrinks_world_after_repeat_node_loss(tmp_path):
+    """Rank 1 SIGKILLs itself mid-pass in generation 0 AND again in
+    generation 1: the first kill respawns it (transient-OOM policy), the
+    repeat kill is the real node-loss verdict — the launcher scales in to
+    a 2-worker generation 2, the job resumes from the shared checkpoint
     and finishes — exit 0, no lost progress."""
     from paddlebox_tpu.launch import launch_elastic
     edir = str(tmp_path / "elastic")
-    rc = launch_elastic(_WORKER, ["kill"], nproc=3, elastic_dir=edir,
+    rc = launch_elastic(_WORKER, ["kill_repeat"], nproc=3,
+                        elastic_dir=edir,
                         min_workers=2, max_relaunches=2,
                         heartbeat_ttl=4.0)
     assert rc == 0
     done = sorted(os.listdir(edir))
-    assert "done-g1-r0" in done and "done-g1-r1" in done
-    assert not any(d.startswith("done-g0") for d in done)
+    assert "done-g2-r0" in done and "done-g2-r1" in done
+    assert not any(d.startswith(("done-g0", "done-g1")) for d in done)
     final = _read_json(os.path.join(edir, "job_ckpt.json"))
-    assert final == {"step": 40, "gen": 1, "world": 2}
+    assert final == {"step": 40, "gen": 2, "world": 2}
+
+
+def test_elastic_single_sigkill_respawns_full_world(tmp_path):
+    """A LONE SIGKILL exit (indistinguishable from a transient OOM kill)
+    must respawn the rank like a crash, not permanently shrink capacity:
+    with min_workers == nproc the old scale-in policy would abort (76);
+    the respawn policy finishes the job at full strength."""
+    from paddlebox_tpu.launch import launch_elastic
+    edir = str(tmp_path / "elastic")
+    rc = launch_elastic(_WORKER, ["kill"], nproc=3, elastic_dir=edir,
+                        min_workers=3, max_relaunches=2,
+                        heartbeat_ttl=4.0)
+    assert rc == 0
+    done = sorted(os.listdir(edir))
+    assert {"done-g1-r0", "done-g1-r1", "done-g1-r2"} <= set(done)
+    final = _read_json(os.path.join(edir, "job_ckpt.json"))
+    assert final == {"step": 40, "gen": 1, "world": 3}
 
 
 def test_elastic_relaunch_detects_heartbeat_partition(tmp_path):
@@ -135,27 +155,31 @@ def test_elastic_relaunch_detects_heartbeat_partition(tmp_path):
 
 
 def test_elastic_grow_request_scales_out(tmp_path):
-    """A pending grow request is honored at the re-rendezvous: the lost
-    rank's capacity is replaced and the new generation runs at full
-    strength again (scale-out, ≙ the reference watching new joiners)."""
+    """A pending grow request is honored at the re-rendezvous after a
+    real (repeat-SIGKILL) node loss: the lost rank's capacity is replaced
+    and the job finishes at full strength again (scale-out, ≙ the
+    reference watching new joiners).  The partition path classifies as
+    loss on the FIRST verdict, so one failure suffices."""
     from paddlebox_tpu.launch import launch_elastic
     edir = str(tmp_path / "elastic")
     os.makedirs(edir, exist_ok=True)
     with open(os.path.join(edir, "grow"), "w") as f:
         f.write("1")
-    rc = launch_elastic(_WORKER, ["kill"], nproc=3, elastic_dir=edir,
+    rc = launch_elastic(_WORKER, ["partition"], nproc=3, elastic_dir=edir,
                         min_workers=2, max_relaunches=2,
-                        heartbeat_ttl=4.0)
+                        heartbeat_ttl=3.0)
     assert rc == 0
     final = _read_json(os.path.join(edir, "job_ckpt.json"))
     assert final["gen"] == 1 and final["world"] == 3
 
 
 def test_elastic_aborts_below_quorum(tmp_path):
-    """Losing a rank with min_workers == nproc must abort, not limp on."""
+    """REALLY losing a rank (repeat SIGKILL) with min_workers == nproc
+    must abort, not limp on."""
     from paddlebox_tpu.launch import launch_elastic
     edir = str(tmp_path / "elastic")
-    rc = launch_elastic(_WORKER, ["kill"], nproc=3, elastic_dir=edir,
+    rc = launch_elastic(_WORKER, ["kill_repeat"], nproc=3,
+                        elastic_dir=edir,
                         min_workers=3, max_relaunches=2,
                         heartbeat_ttl=4.0)
     assert rc == 76
@@ -164,7 +188,7 @@ def test_elastic_aborts_below_quorum(tmp_path):
 def test_elastic_grow_after_spent_budget_keeps_job_alive(tmp_path):
     """A grow request on a HEALTHY job with exhausted failure budget must
     not kill it: voluntary scale-out is free, and a no-op grow (already at
-    the nproc cap) is ignored entirely."""
+    the nproc cap) stays pending instead of being silently burned."""
     from paddlebox_tpu.launch import launch_elastic
     edir = str(tmp_path / "elastic")
     os.makedirs(edir, exist_ok=True)
@@ -177,4 +201,6 @@ def test_elastic_grow_after_spent_budget_keeps_job_alive(tmp_path):
     assert rc == 0
     final = _read_json(os.path.join(edir, "job_ckpt.json"))
     assert final["gen"] == 0 and final["world"] == 2
-    assert not os.path.exists(os.path.join(edir, "grow"))  # consumed
+    # an at-cap request is NOT consumed: it waits for a re-rendezvous
+    # that can honor it (a scale-in would then regrow from it)
+    assert os.path.exists(os.path.join(edir, "grow"))

@@ -1,0 +1,448 @@
+"""pboxlint: per-checker unit tests (positive + negative snippets), the
+suppression machinery, the CLI, and the tier-1 whole-package gate.
+
+The regression snippet in test_cli_flags_prefix_service_lock_bug is the
+PRE-FIX ps/service.py pull_sparse pattern (ADVICE.md round-5: the learned
+row-size estimate mutated outside self._lock) — the canary PB102 must keep
+catching even though the tree itself is fixed.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from paddlebox_tpu.tools.pboxlint import lint_paths, lint_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(src, path="snippet.py"):
+    return [f.code for f in lint_source(textwrap.dedent(src), path)]
+
+
+# -- PB1xx lock discipline ---------------------------------------------------
+
+def test_pb101_flags_mutation_outside_lock():
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def locked(self):
+            with self._lock:
+                self._n = 1
+
+        def unlocked(self):
+            self._n = 2
+    """
+    assert codes(src) == ["PB101"]
+
+
+def test_pb101_negative_all_mutations_under_lock():
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def a(self):
+            with self._lock:
+                self._n = 1
+
+        def b(self):
+            with self._lock:
+                self._n += 2
+    """
+    assert codes(src) == []
+
+
+def test_pb101_init_writes_do_not_count():
+    # __init__ runs before the instance is shared — its bare writes must
+    # not turn every lock-guarded attribute into a finding
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def a(self):
+            with self._lock:
+                self._n = 1
+    """
+    assert codes(src) == []
+
+
+def test_pb102_flags_unlocked_read_modify_write():
+    src = """
+    import threading
+
+    class Client:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._est = 512
+
+        def _call(self):
+            with self._lock:
+                return 1
+
+        def pull(self):
+            per = self._est
+            rows = self._call()
+            self._est = per + rows
+            return rows
+    """
+    assert codes(src) == ["PB102"]
+
+
+def test_pb102_negative_rmw_under_lock():
+    src = """
+    import threading
+
+    class Client:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._est = 512
+
+        def pull(self):
+            with self._lock:
+                per = self._est
+                self._est = per + 1
+            return per
+    """
+    assert codes(src) == []
+
+
+def test_pb103_bare_acquire_without_try_finally():
+    src = """
+    import threading
+    lock = threading.Lock()
+
+    def bad():
+        lock.acquire()
+        work()
+        lock.release()
+
+    def good():
+        lock.acquire()
+        try:
+            work()
+        finally:
+            lock.release()
+    """
+    assert codes(src) == ["PB103"]
+
+
+# -- PB2xx flag hygiene ------------------------------------------------------
+
+def test_pb201_unregistered_flag_name():
+    src = """
+    from paddlebox_tpu.flags import define_flag, get_flags, set_flags
+
+    define_flag("real_flag", 1, "help")
+    a = get_flags("real_flag")
+    b = get_flags("typo_flag")
+    set_flags({"real_flag": 2, "other_typo": 3})
+    """
+    assert codes(src) == ["PB201", "PB201"]
+
+
+def test_pb202_default_must_roundtrip_coerce():
+    src = """
+    from paddlebox_tpu.flags import define_flag
+
+    define_flag("ok_int", 20, "fine")
+    define_flag("ok_bool", True, "fine")
+    define_flag("ok_str", "auto", "fine")
+    define_flag("bad_list", [1, 2], "env override cannot parse a list")
+    """
+    assert codes(src) == ["PB202"]
+
+
+def test_pb203_raw_flags_environ_read():
+    src = """
+    import os
+
+    a = os.environ["FLAGS_record_pool_max_size"]
+    b = os.getenv("FLAGS_check_nan_inf")
+    c = os.environ.get("FLAGS_feed_pass_thread_num")
+    d = os.environ["HOME"]          # non-FLAGS: fine
+    """
+    assert sorted(codes(src)) == ["PB203", "PB203", "PB203"]
+    # the registry itself is allowed to read its own env overrides
+    assert codes(src, path="flags.py") == []
+
+
+# -- PB3xx JAX purity --------------------------------------------------------
+
+def test_pb301_host_sync_in_jitted_fn():
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def bad(x):
+        print(x)
+        y = np.asarray(x)
+        return float(y)
+
+    def fine(x):
+        print(x)                    # not traced: host calls are fine
+        return float(np.asarray(x))
+    """
+    assert codes(src) == ["PB301", "PB301", "PB301"]
+
+
+def test_pb301_scan_body_and_partial_jit():
+    src = """
+    from functools import partial
+    import jax
+    from jax import lax
+    from paddlebox_tpu.flags import define_flag, get_flags
+
+    define_flag("learning_rate", 0.05, "registered: no PB201 noise")
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(ws, x):
+        lr = get_flags("learning_rate")
+        return ws, x
+
+    def body(carry, x):
+        v = x.item()
+        return carry, v
+
+    def run(xs):
+        return lax.scan(body, 0.0, xs)
+    """
+    assert codes(src) == ["PB301", "PB301"]
+
+
+def test_pb302_trace_time_state_mutation():
+    src = """
+    import jax
+
+    class T:
+        def build(self):
+            @jax.jit
+            def step(self, x):
+                self.cache = x          # baked in at trace time
+                return x
+            return step
+    """
+    assert codes(src) == ["PB302"]
+
+
+def test_pb302_negative_rebound_copy_is_functional_update():
+    # `ws = dict(ws)` then item-assign is the idiomatic functional update
+    # (trainer/graph_trainer.py) — NOT trace-time state mutation
+    src = """
+    import jax
+
+    @jax.jit
+    def step(ws, g):
+        ws = dict(ws)
+        ws["mf"] = ws["mf"] - g
+        return ws
+    """
+    assert codes(src) == []
+
+
+# -- PB4xx threading lifecycle -----------------------------------------------
+
+def test_pb401_thread_without_daemon_or_join():
+    src = """
+    import threading
+
+    def bad():
+        t = threading.Thread(target=work)
+        t.start()
+
+    def good_daemon():
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+
+    def good_joined():
+        t = threading.Thread(target=work)
+        t.start()
+        t.join()
+    """
+    assert codes(src) == ["PB401"]
+
+
+def test_pb401_class_scope_join_in_other_method():
+    src = """
+    import threading
+
+    class Pool:
+        def start(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+
+        def stop(self):
+            self._t.join()
+
+    class Leak:
+        def start(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+    """
+    assert codes(src) == ["PB401"]
+
+
+def test_pb402_blocking_queue_get_in_loop():
+    src = """
+    import queue
+
+    def bad(q2):
+        q = queue.Queue()
+        while True:
+            item = q.get()
+            handle(item)
+
+    def good_sentinel():
+        q = queue.Queue()
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            handle(item)
+
+    def good_timeout():
+        q = queue.Queue()
+        while True:
+            handle(q.get(timeout=5))
+    """
+    assert codes(src) == ["PB402"]
+
+
+def test_pb402_queue_gated_loop_is_fine():
+    src = """
+    import queue
+
+    def drain():
+        q = queue.Queue()
+        out = []
+        while q.qsize():
+            out.append(q.get())
+        return out
+    """
+    # the loop only calls get() when the queue reports an item
+    assert codes(src) == []
+
+
+# -- suppressions ------------------------------------------------------------
+
+def test_suppression_same_line_and_next_line():
+    base = """
+    import threading
+
+    def bad():
+        t = threading.Thread(target=work)
+        t.start()
+    """
+    assert codes(base) == ["PB401"]
+    inline = base.replace(
+        "t = threading.Thread(target=work)",
+        "t = threading.Thread(target=work)  "
+        "# pboxlint: disable=PB401 -- test")
+    assert codes(inline) == []
+    nxt = base.replace(
+        "        t = threading.Thread(target=work)",
+        "        # pboxlint: disable-next=PB401 -- test\n"
+        "        t = threading.Thread(target=work)")
+    assert codes(nxt) == []
+
+
+def test_suppression_is_code_specific():
+    src = """
+    import threading
+
+    def bad():
+        t = threading.Thread(target=work)  # pboxlint: disable=PB999
+        t.start()
+    """
+    assert codes(src) == ["PB401"]      # wrong code: not suppressed
+
+
+# -- CLI + whole-package tier-1 gate -----------------------------------------
+
+_PREFIX_SERVICE_SNIPPET = """
+import threading
+
+
+class PSClient:
+    def __init__(self):
+        self._row_bytes_est = 512       # adapted from observed responses
+        self._rows_learned = False      # first pull probes conservatively
+        self._lock = threading.Lock()
+
+    def _call(self, req):
+        with self._lock:
+            return {"rows": req}
+
+    def _per_chunk(self, bytes_per_row):
+        return max(1, 2 ** 22 // max(bytes_per_row, 1))
+
+    def pull_sparse(self, keys):
+        parts = []
+        lo = 0
+        while lo < len(keys):
+            per = self._per_chunk(self._row_bytes_est)
+            if not self._rows_learned:
+                per = min(per, 65536)
+            c = min(per, len(keys) - lo)
+            rows = self._call({"keys": keys[lo:lo + c]})["rows"]
+            if c:
+                self._row_bytes_est = max(len(rows), 8)
+                self._rows_learned = True
+            parts.append(rows)
+            lo += c
+        return parts
+"""
+
+
+def test_cli_flags_prefix_service_lock_bug(tmp_path):
+    """The PRE-FIX ps/service.py pull_sparse estimate (mutated outside
+    self._lock) must exit the CLI non-zero with PB102 — the ADVICE.md
+    canary this suite was built around."""
+    snip = tmp_path / "prefix_service.py"
+    snip.write_text(_PREFIX_SERVICE_SNIPPET)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddlebox_tpu.tools.pboxlint", str(snip)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "PB102" in proc.stdout
+    assert "_row_bytes_est" in proc.stdout
+
+
+def test_cli_parse_failure_exits_2(tmp_path):
+    snip = tmp_path / "broken.py"
+    snip.write_text("def f(:\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddlebox_tpu.tools.pboxlint", str(snip)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 2
+    assert "PB000" in proc.stdout
+
+
+def test_whole_package_zero_findings():
+    """The tier-1 gate: every checker over the whole package, zero
+    findings — the analyzer and the tree stay clean together."""
+    findings, errors = lint_paths([os.path.join(REPO, "paddlebox_tpu")])
+    assert not errors, errors
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_cli_whole_package_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddlebox_tpu.tools.pboxlint",
+         "paddlebox_tpu/"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -279,3 +279,35 @@ def test_transparent_chunking_moves_oversized_pulls_and_pushes():
             final["show"], np.arange(n, dtype=np.float32) + 2.0)
     finally:
         srv.shutdown()
+
+
+def test_row_size_estimate_is_per_table_and_locked():
+    """ADVICE.md round-5: the pull_sparse learned row-size estimate was a
+    single per-client scalar mutated outside self._lock — after learning
+    a narrow table, a pull from a much wider table sized its first chunk
+    from the stale estimate and could overshoot the hard wire cap.  The
+    estimate is now a per-table dict updated under the lock: each table
+    learns its own width, and an unlearned table always re-probes."""
+    from paddlebox_tpu.ps.service import DEFAULT_TABLE
+    narrow = ShardedHostTable(EmbeddingTableConfig(embedding_dim=1,
+                                                   shard_num=2))
+    wide = ShardedHostTable(EmbeddingTableConfig(embedding_dim=256,
+                                                 shard_num=2))
+    srv = PSServer({DEFAULT_TABLE: narrow, "wide": wide})
+    try:
+        client = PSClient(srv.addr, max_frame=1 << 16)
+        keys = np.arange(1, 2001, dtype=np.uint64)
+        client.pull_sparse(keys, create=True)                 # narrow
+        assert set(client._row_bytes_est) == {DEFAULT_TABLE}
+        n_est = client._row_bytes_est[DEFAULT_TABLE]
+        # first pull of the wide table must NOT reuse the narrow width:
+        # it re-probes (unlearned branch) and learns its own entry
+        rows = client.pull_sparse(keys, table="wide", create=True)
+        assert rows["mf"].shape == (2000, 256)
+        w_est = client._row_bytes_est["wide"]
+        assert client._row_bytes_est[DEFAULT_TABLE] == n_est
+        assert w_est > 4 * n_est        # widths learned independently
+        # and the narrow table's chunks stay sized by its own width
+        assert client._per_chunk(n_est) > client._per_chunk(w_est)
+    finally:
+        srv.shutdown()

@@ -84,7 +84,7 @@ def test_single_class_users_skipped():
 def test_empty():
     out = WuAucCalculator().compute()
     assert out == {"uauc": 0.0, "wuauc": 0.0, "user_cnt": 0.0, "size": 0.0,
-                   "nan_inf_rate": 0.0}
+                   "nan_inf_rate": 0.0, "out_of_range_rate": 0.0}
 
 
 def test_metric_group_registration():
@@ -120,6 +120,27 @@ def test_non_finite_preds_dropped():
     calc2 = WuAucCalculator()
     calc2.add_data([np.nan], [1], [3])
     assert calc2.compute()["nan_inf_rate"] == 1.0
+
+
+def test_out_of_range_preds_counted_but_still_ranked():
+    """Preds outside [0,1] (non-sigmoid heads) violate the reference's
+    add_uid_unlock_data precondition (it PADDLE_ENFORCEs the range); here
+    they stay in the ranking — order is all Mann-Whitney needs — but are
+    surfaced through out_of_range_rate."""
+    calc = WuAucCalculator()
+    calc.add_data([1.7, 0.5, -0.2, 0.1], [1, 0, 0, 1], [9, 9, 9, 9])
+    out = calc.compute()
+    assert out["out_of_range_rate"] == pytest.approx(2 / 4)
+    # ranking unchanged: the sigmoid of those logits (order-preserving)
+    # must give the identical per-user AUC, with a zero violation count
+    calc2 = WuAucCalculator()
+    calc2.add_data([0.8455, 0.6225, 0.4502, 0.5250], [1, 0, 0, 1],
+                   [9, 9, 9, 9])
+    out2 = calc2.compute()
+    assert out2["uauc"] == out["uauc"]
+    assert out2["out_of_range_rate"] == 0.0
+    calc.reset()
+    assert calc.compute()["out_of_range_rate"] == 0.0
 
 
 def test_multi_task_metric_selects_task_column():
