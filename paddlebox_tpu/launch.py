@@ -304,20 +304,43 @@ def main():
                          "shared dir (≙ the etcd prefix)")
     ap.add_argument("--min_workers", type=int, default=1)
     ap.add_argument("--max_relaunches", type=int, default=3)
+    ap.add_argument("--chaos_backend", default="",
+                    help="host:port of a live PSServer; the launcher "
+                         "spawns a seeded ChaosProxy (ps/faults.py) in "
+                         "front of it and exports PBOX_PS_ADDR so workers "
+                         "train through injected connection chaos — the "
+                         "multi-process face of the chaos soak suite")
+    ap.add_argument("--chaos_seed", type=int, default=0)
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
-    if args.elastic_dir:
-        host, _, port = args.coordinator.rpartition(":")
-        sys.exit(launch_elastic(
-            args.script, args.script_args, args.nproc_per_node,
-            args.elastic_dir,
-            coordinator_host=host or "127.0.0.1",
-            coordinator_base_port=int(port) if port else 12400,
-            min_workers=args.min_workers,
-            max_relaunches=args.max_relaunches, log_dir=args.log_dir))
-    sys.exit(launch(args.script, args.script_args, args.nproc_per_node,
-                    args.coordinator, args.max_restarts, args.log_dir))
+    proxy = None
+    if args.chaos_backend:
+        from paddlebox_tpu.ps.faults import ChaosProxy, FaultPlan
+        bhost, _, bport = args.chaos_backend.rpartition(":")
+        proxy = ChaosProxy((bhost or "127.0.0.1", int(bport)),
+                           FaultPlan.default_chaos(args.chaos_seed))
+        os.environ["PBOX_PS_ADDR"] = f"{proxy.addr[0]}:{proxy.addr[1]}"
+        print(f"[chaos] proxy {proxy.addr} -> {args.chaos_backend} "
+              f"(seed {args.chaos_seed})", file=sys.stderr)
+    try:
+        if args.elastic_dir:
+            host, _, port = args.coordinator.rpartition(":")
+            rc = launch_elastic(
+                args.script, args.script_args, args.nproc_per_node,
+                args.elastic_dir,
+                coordinator_host=host or "127.0.0.1",
+                coordinator_base_port=int(port) if port else 12400,
+                min_workers=args.min_workers,
+                max_relaunches=args.max_relaunches, log_dir=args.log_dir)
+        else:
+            rc = launch(args.script, args.script_args,
+                        args.nproc_per_node, args.coordinator,
+                        args.max_restarts, args.log_dir)
+    finally:
+        if proxy is not None:
+            proxy.shutdown()
+    sys.exit(rc)
 
 
 if __name__ == "__main__":

@@ -137,6 +137,73 @@ def default_rows(n: int, mf_dim: int, rng: np.random.Generator,
     return soa
 
 
+_MASK64 = (1 << 64) - 1
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer on a Python int (scalar seeds/column ids)."""
+    x = (x + 0x9E3779B97F4A7C15) & _MASK64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _MASK64
+    return x ^ (x >> 31)
+
+
+def _keyed_hash(keys: np.ndarray, salt: int) -> np.ndarray:
+    """Vectorized splitmix64 of (key ^ salt) — uint64 in, uint64 out."""
+    z = (keys.astype(np.uint64) ^ np.uint64(salt)) + \
+        np.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def keyed_uniform(keys: np.ndarray, seed: int, col: int,
+                  lo: float, hi: float) -> np.ndarray:
+    """U(lo, hi) as a PURE FUNCTION of (seed, key, col) — float32, one
+    value per key.  Used for fresh-row defaults so initialization is
+    invariant to pull order, retries, and which worker pulls first."""
+    h = _keyed_hash(np.asarray(keys, np.uint64), _mix64(seed * 2654435761
+                                                        + col))
+    u = (h >> np.uint64(11)).astype(np.float64) * (1.0 / (1 << 53))
+    return (lo + (hi - lo) * u).astype(np.float32)
+
+
+def default_rows_keyed(keys: np.ndarray, mf_dim: int, seed: int,
+                       mf_initial_range: float, initial_range: float = 0.0,
+                       expand_dim: int = 0, adam: bool = False,
+                       beta1: float = 0.9, beta2: float = 0.999,
+                       optimizer: str = "",
+                       double_stats: bool = False) -> Dict[str, np.ndarray]:
+    """:func:`default_rows`, but KEY-DETERMINISTIC: every random init is a
+    pure function of (table seed, feasign, column) via a splitmix64 hash
+    instead of a shared stateful Generator.  Two pulls of the same unseen
+    key — across retries, chunk orders, or workers — produce identical
+    rows, which is what makes a chaos-replayed day bit-identical to the
+    fault-free run (tests/test_chaos_soak.py) and multi-trainer bases
+    consistent without relying on who pulls first."""
+    keys = np.asarray(keys, np.uint64)
+    n = len(keys)
+    soa = empty_soa(n, mf_dim, expand_dim, adam, optimizer, double_stats)
+    if initial_range > 0:
+        soa["embed_w"] = keyed_uniform(keys, seed, 0,
+                                       -initial_range, initial_range)
+    soa["mf"] = np.stack(
+        [keyed_uniform(keys, seed, 1 + d, 0.0, mf_initial_range)
+         for d in range(mf_dim)], axis=1) if mf_dim else \
+        np.zeros((n, 0), np.float32)
+    if expand_dim > 0:
+        soa["mf_ex"] = np.stack(
+            [keyed_uniform(keys, seed, 1 + mf_dim + d,
+                           0.0, mf_initial_range)
+             for d in range(expand_dim)], axis=1)
+    if "embed_b1p" in soa:
+        soa["embed_b1p"][:] = beta1
+        soa["embed_b2p"][:] = beta2
+        soa["mf_b1p"][:] = beta1
+        soa["mf_b2p"][:] = beta2
+    return soa
+
+
 def select_rows(soa: Dict[str, np.ndarray], idx: np.ndarray
                 ) -> Dict[str, np.ndarray]:
     return {k: v[idx] for k, v in soa.items()}

@@ -136,7 +136,11 @@ class ShardedHostTable:
         self._shards = [_Shard(self.mf_dim, self.expand_dim, self.adam,
                                self.optimizer, self.double_stats)
                         for _ in range(self.shard_num)]
-        self._rng = np.random.default_rng(seed)
+        # fresh-row init is KEY-DETERMINISTIC (fv.default_rows_keyed): a
+        # pure function of (seed, key), never a shared stateful RNG — so
+        # retried/reordered pulls (exactly-once retry protocol, chaos
+        # replays) and multi-worker first-pulls all see identical defaults
+        self._seed = seed
 
     # -- introspection -------------------------------------------------------
     def size(self) -> int:
@@ -150,14 +154,13 @@ class ShardedHostTable:
         """Read rows for unique `keys` (read-only; unseen keys get fresh
         default rows — insertion happens at write-back, matching the
         build-pass flow ps_gpu_wrapper.cc:337-760)."""
-        n = len(keys)
-        out = fv.default_rows(n, self.mf_dim, self._rng,
-                              self.config.sgd.mf_initial_range,
-                              self.config.sgd.initial_range,
-                              self.expand_dim, self.adam,
-                              self.config.sgd.beta1_decay_rate,
-                              self.config.sgd.beta2_decay_rate,
-                              self.optimizer, self.double_stats)
+        out = fv.default_rows_keyed(keys, self.mf_dim, self._seed,
+                                    self.config.sgd.mf_initial_range,
+                                    self.config.sgd.initial_range,
+                                    self.expand_dim, self.adam,
+                                    self.config.sgd.beta1_decay_rate,
+                                    self.config.sgd.beta2_decay_rate,
+                                    self.optimizer, self.double_stats)
         sid = self._shard_ids(keys)
         for s, shard in enumerate(self._shards):
             sel = np.nonzero(sid == s)[0]

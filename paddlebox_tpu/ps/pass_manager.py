@@ -31,6 +31,7 @@ from paddlebox_tpu.config import EmbeddingTableConfig
 from paddlebox_tpu.parallel.topology import HybridTopology
 from paddlebox_tpu.ps import embedding
 from paddlebox_tpu.ps.host_table import ShardedHostTable
+from paddlebox_tpu.utils.monitor import stat_add
 from paddlebox_tpu.utils.timer import TimerRegistry
 
 
@@ -213,6 +214,10 @@ class BoxPSEngine:
         if not len(stale):
             return
         with self.timers("refresh_stale"):
+            # remote tables: this pull retries through the exactly-once
+            # protocol (service.py) — a dropped connection here no longer
+            # aborts the pass adoption
+            stat_add("ps.engine.stale_refresh_rows", float(len(stale)))
             fresh = self.table.bulk_pull(stale)
             if getattr(self, "_pulled_stats", None) is not None:
                 pos = np.searchsorted(self.mapper.sorted_keys, stale)
@@ -233,7 +238,15 @@ class BoxPSEngine:
 
     def end_pass(self, need_save_delta: bool = False,
                  delta_path: str = "") -> None:
-        """Write the trained working set back to the DRAM tier."""
+        """Write the trained working set back to the DRAM tier.
+
+        Pass-level recovery contract: if the write-back raises (remote PS
+        unreachable past the client's retry deadline), the engine state —
+        ``ws``, ``mapper``, ``_pulled_stats`` — is left intact and a
+        delta-mode RemoteTableAdapter restores its pull snapshot + pins
+        the chunk rid-group, so calling ``end_pass`` again replays the
+        SAME write-back exactly-once (already-applied chunks dedup
+        server-side)."""
         assert self.ws is not None and self.mapper is not None
         if embedding.is_quantized(self.ws):
             raise RuntimeError(
@@ -252,8 +265,15 @@ class BoxPSEngine:
                     soa[f] = self._pulled_stats[f] + \
                         soa[f + "_acc"].astype(np.float64)
                     del soa[f + "_acc"]
-                self._pulled_stats = None
-            self.table.bulk_write(self.mapper.sorted_keys, soa)
+            try:
+                self.table.bulk_write(self.mapper.sorted_keys, soa)
+            except Exception:
+                # keep _pulled_stats/ws/mapper: a re-driven end_pass must
+                # rebuild the IDENTICAL soa (clearing the stats first used
+                # to make the retry write absolute f32 values — divergent)
+                stat_add("ps.engine.end_pass_write_failure")
+                raise
+            self._pulled_stats = None
         self.ws = None
         self._last_written = np.asarray(self.mapper.sorted_keys)
         if need_save_delta and delta_path:

@@ -9,28 +9,53 @@ like sendrecv.proto's VariableMessage; NO pickle touches network bytes).
 Several named tables ride one service (≙ brpc's table_id-routed cmds /
 the_one_ps multi-table deployment); trainers on other hosts pull pass
 working sets from, and flush them to, this service instead of their local
-DRAM (the multi-host BuildPull path, ps_gpu_wrapper.cc:337-419, including
-the retry-then-fail discipline :388-419).
+DRAM (the multi-host BuildPull path, ps_gpu_wrapper.cc:337-419).
+
+Retry discipline (upgraded from the reference's retry-then-fail,
+ps_gpu_wrapper.cc:388-419): EVERY verb is safely retryable.  Idempotent
+verbs simply resend; non-idempotent verbs (``push_sparse_delta``,
+``push_dense``, ``barrier``, ``allreduce``, ``end_day``) carry a
+client-generated request id (``rid`` = client token + monotonic seq,
+wire.RID_FIELD) that the server dedups through a bounded per-client
+window in :class:`PSServer` — a resend of an applied-but-unacknowledged
+mutation returns the cached response instead of applying twice
+(exactly-once under ambiguous failure).  The client backs off
+exponentially with jitter under an overall deadline budget
+(utils/backoff.Backoff).  Fault injection hooks (ps/faults.py) ride the
+``connect``/``send``/``recv``/``dispatch`` sites when armed; production
+pays one ``is None`` check per site.
 """
 
 from __future__ import annotations
 
+import logging
+import os
 import socket
 import socketserver
 import struct
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, Optional, Tuple, Union
 
 import numpy as np
 
-from paddlebox_tpu.ps import wire
+from paddlebox_tpu import flags
+from paddlebox_tpu.ps import faults, wire
 from paddlebox_tpu.ps.host_table import ShardedHostTable
+from paddlebox_tpu.utils.backoff import Backoff
+from paddlebox_tpu.utils.monitor import stat_add
 
 DEFAULT_TABLE = "embedding"
 
+flags.define_flag(
+    "ps_dedup_window", 1024,
+    "per-client-token cap of the PS server's rid->response dedup window; "
+    "exactly-once holds for resends within the newest <window> requests "
+    "of a client (must exceed the chunk count of one logical delta push)")
 
-def _send(sock, msg: Dict) -> None:
+
+def _send(sock, msg: Dict, role: str = "client") -> None:
     payload = wire.encode(msg)
     if len(payload) > wire.MAX_FRAME:
         # non-retryable by construction (RuntimeError, not ConnectionError):
@@ -38,10 +63,15 @@ def _send(sock, msg: Dict) -> None:
         raise RuntimeError(
             f"frame of {len(payload)} bytes exceeds wire cap "
             f"{wire.MAX_FRAME} — split the request (fewer keys per call)")
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+    frame = struct.pack("<Q", len(payload)) + payload
+    if faults.ACTIVE is not None:
+        faults.on_send(sock, frame, role)
+    sock.sendall(frame)
 
 
-def _recv(sock) -> Dict:
+def _recv(sock, role: str = "client") -> Dict:
+    if faults.ACTIVE is not None:
+        faults.on_recv(role)
     head = b""
     while len(head) < 8:
         chunk = sock.recv(8 - len(head))
@@ -60,11 +90,108 @@ def _recv(sock) -> Dict:
     return wire.decode(bytes(buf))
 
 
+class _DedupWindow:
+    """Bounded per-client rid → cached-response window (the server half of
+    the exactly-once protocol).
+
+    A rid is ``<token>:<tail>``; entries group by token.  ``begin`` either
+    admits a new rid (returns None — caller executes the verb and must
+    ``commit`` or ``drop``), returns the cached response of a completed
+    duplicate, or blocks while the original is still executing (a blocking
+    verb like barrier whose first connection died keeps its handler thread
+    registered — the resend must WAIT for that execution, never start a
+    second one).
+
+    Bounded-memory contract: at most ``cap`` completed entries per token
+    and ``token_cap`` tokens (LRU); in-flight entries are never evicted.
+    A resend older than the newest ``cap`` rids of its client re-executes
+    — callers keep ``cap`` above the chunk count of one logical verb.
+    """
+
+    def __init__(self, cap: int = 1024, token_cap: int = 1024,
+                 wait_timeout: float = 120.0):
+        self.cap = cap
+        self.token_cap = token_cap
+        self.wait_timeout = wait_timeout
+        self._cv = threading.Condition()
+        # token -> OrderedDict[rid -> [done, resp]]
+        self._by_token: "OrderedDict[str, OrderedDict]" = OrderedDict()
+
+    @staticmethod
+    def _token(rid: str) -> str:
+        return rid.rsplit(":", 1)[0]
+
+    def begin(self, rid: str) -> Optional[Dict]:
+        tok = self._token(rid)
+        deadline = time.monotonic() + self.wait_timeout
+        with self._cv:
+            while True:
+                entries = self._by_token.get(tok)
+                if entries is not None:
+                    self._by_token.move_to_end(tok)
+                entry = None if entries is None else entries.get(rid)
+                if entry is None:
+                    if entries is None:
+                        entries = self._by_token[tok] = OrderedDict()
+                        while len(self._by_token) > self.token_cap:
+                            self._by_token.popitem(last=False)
+                            stat_add("ps.server.dedup_token_evict")
+                    entries[rid] = [False, None]    # in-flight
+                    return None
+                if entry[0]:                        # done → replay
+                    stat_add("ps.server.dedup_hit")
+                    return entry[1]
+                # original still executing on another handler thread
+                stat_add("ps.server.dedup_wait")
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return {"ok": False,
+                            "error": f"duplicate of rid {rid} still "
+                                     f"executing after {self.wait_timeout}s"}
+                self._cv.wait(rem)
+
+    def commit(self, rid: str, resp: Dict) -> None:
+        tok = self._token(rid)
+        with self._cv:
+            entries = self._by_token.get(tok)
+            if entries is not None and rid in entries:
+                entries[rid][:] = [True, resp]
+                # eviction is by COMPLETION order: the entry just
+                # committed must outlive older completions, or a tiny cap
+                # could evict the response a blocked duplicate is waiting
+                # for before it wakes
+                entries.move_to_end(rid)
+                done = [r for r, e in entries.items() if e[0]]
+                for r in done[:max(0, len(done) - self.cap)]:
+                    del entries[r]
+                    stat_add("ps.server.dedup_evict")
+            self._cv.notify_all()
+
+    def drop(self, rid: str) -> None:
+        """The verb raised (nothing committed, or it rolled back — e.g. a
+        barrier timeout): forget the rid so a resend re-executes."""
+        tok = self._token(rid)
+        with self._cv:
+            entries = self._by_token.get(tok)
+            if entries is not None:
+                entries.pop(rid, None)
+            self._cv.notify_all()
+
+
+class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
+    # chaos restarts rebind the same port while old sockets drain TIME_WAIT
+    allow_reuse_address = True
+    daemon_threads = True
+
+
 class PSServer:
     """Hosts named ShardedHostTables + a dense blob store behind TCP verbs:
     pull_sparse/push_sparse/pull_dense/push_dense/save/load/shrink/
-    end_day/size/barrier/list_tables (the BrpcPsService cmd surface with
-    table-name routing ≙ table_id)."""
+    end_day/size/barrier/allreduce/list_tables/health (the BrpcPsService
+    cmd surface with table-name routing ≙ table_id).  Requests carrying a
+    rid are routed through the dedup window (exactly-once); ``shutdown``
+    drains gracefully (stop accepting, finish in-flight verbs) and
+    ``kill`` is the chaos harness's abrupt mid-verb death."""
 
     def __init__(self, table: Union[ShardedHostTable,
                                     Dict[str, ShardedHostTable]],
@@ -86,26 +213,76 @@ class PSServer:
         # fleet/metrics/metric.py:144)
         self._reduce_cv = threading.Condition()
         self._reduces: Dict[str, Dict] = {}
+        self._dedup = _DedupWindow(cap=flags.get_flags("ps_dedup_window"))
+        # lifecycle: _life_lock guards the dead flag (shutdown/kill may
+        # race from a fault hook thread); _inflight_cv counts verbs being
+        # executed so a graceful drain can wait them out
+        self._life_lock = threading.Lock()
+        self._dead = False
+        self._draining = False
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
+
+            def finish(self):
+                with outer._conns_lock:
+                    outer._conns.discard(self.request)
+
             def handle(self):
                 while True:
                     try:
-                        req = _recv(self.request)
+                        req = _recv(self.request, role="server")
                     except (ConnectionError, OSError, wire.DecodeError):
                         # malformed frame → stream sync is gone; drop the
                         # connection (client reconnects + retries)
                         return
+                    with outer._inflight_cv:
+                        outer._inflight += 1
                     try:
-                        resp = outer._dispatch(req)
-                    except Exception as e:  # noqa: BLE001
-                        resp = {"ok": False, "error": repr(e)}
-                    _send(self.request, resp)
+                        try:
+                            resp = outer._dispatch(req)
+                        except faults.InjectedFault:
+                            # injected mid-verb death: no response — the
+                            # client's retry resolves through the dedup
+                            # window (or a clean re-execute)
+                            return
+                        except Exception as e:  # noqa: BLE001
+                            resp = {"ok": False, "error": repr(e)}
+                        try:
+                            _send(self.request, resp, role="server")
+                        except RuntimeError as e:
+                            # oversized RESPONSE: dying silently here would
+                            # show the client a bare ConnectionError and it
+                            # would re-pull the same oversized chunk — reply
+                            # with the real reason instead (non-retryable)
+                            err = {"ok": False,
+                                   "error": f"response exceeds wire cap — "
+                                            f"{e} (pull fewer keys per "
+                                            f"call)"}
+                            if wire.RID_FIELD in req:
+                                err[wire.RID_FIELD] = req[wire.RID_FIELD]
+                            try:
+                                _send(self.request, err, role="server")
+                            except (RuntimeError, ConnectionError, OSError):
+                                return
+                        except (ConnectionError, OSError):
+                            return
+                    finally:
+                        with outer._inflight_cv:
+                            outer._inflight -= 1
+                            outer._inflight_cv.notify_all()
+                    if outer._draining:
+                        return              # drain: finish-current, then out
 
-        self._srv = socketserver.ThreadingTCPServer((host, port), Handler,
-                                                    bind_and_activate=True)
-        self._srv.daemon_threads = True
+        self._srv = _ThreadingTCPServer((host, port), Handler,
+                                        bind_and_activate=True)
         self.addr: Tuple[str, int] = self._srv.server_address
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
@@ -125,6 +302,27 @@ class PSServer:
         return t
 
     def _dispatch(self, req: Dict) -> Dict:
+        """Fault hook + exactly-once wrapper around the verb switch."""
+        if faults.ACTIVE is not None:
+            faults.on_dispatch(req.get("cmd"), self)
+        rid = req.get(wire.RID_FIELD)
+        if rid is None:
+            return self._exec(req)
+        cached = self._dedup.begin(rid)
+        if cached is not None:
+            return cached
+        try:
+            resp = self._exec(req)
+        except BaseException:
+            # nothing applied, or the verb rolled itself back (barrier/
+            # allreduce timeout paths) — a resend must re-execute
+            self._dedup.drop(rid)
+            raise
+        resp[wire.RID_FIELD] = rid      # echo: client rejects stale frames
+        self._dedup.commit(rid, resp)
+        return resp
+
+    def _exec(self, req: Dict) -> Dict:
         cmd = req["cmd"]
         if cmd == "pull_sparse":
             t = self._table(req)
@@ -188,6 +386,14 @@ class PSServer:
         if cmd == "list_tables":
             return {"ok": True,
                     "tables": {n: t.size() for n, t in self.tables.items()}}
+        if cmd == "health":
+            # heartbeat: cheap liveness + drain visibility for clients and
+            # the launcher's replica watch
+            with self._inflight_cv:
+                inflight = self._inflight
+            return {"ok": True, "draining": self._draining,
+                    "inflight": inflight,
+                    "tables": ",".join(sorted(self.tables))}
         if cmd == "barrier":
             world = req["world"]
             with self._barrier_cv:
@@ -271,21 +477,75 @@ class PSServer:
             return {"ok": True, "arrs": result}
         return {"ok": False, "error": f"unknown cmd {cmd}"}
 
-    def shutdown(self) -> None:
+    # -- lifecycle -----------------------------------------------------------
+    def _mark_dead(self) -> bool:
+        with self._life_lock:
+            if self._dead:
+                return False
+            self._dead = True
+            return True
+
+    def shutdown(self, drain_timeout: float = 5.0) -> None:
+        """Graceful drain: stop accepting, let in-flight verbs finish
+        (bounded by ``drain_timeout``), then close every connection."""
+        if not self._mark_dead():
+            return
+        self._draining = True
+        self._srv.shutdown()            # stop accepting new connections
+        with self._inflight_cv:
+            deadline = time.monotonic() + drain_timeout
+            while self._inflight > 0:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    break
+                self._inflight_cv.wait(rem)
+        self._srv.server_close()
+        self._close_conns()
+
+    def kill(self) -> None:
+        """Abrupt death (the chaos harness's mid-verb server loss): no
+        drain — the listener and every live connection drop on the floor.
+        Table state survives in-process; a restart on the same port
+        resumes service (the dedup window does NOT survive — exactly-once
+        across a kill holds because injected kills fire before the verb
+        applies)."""
+        if not self._mark_dead():
+            return
         self._srv.shutdown()
         self._srv.server_close()
+        self._close_conns()
+
+    def _close_conns(self) -> None:
+        with self._conns_lock:
+            conns = list(self._conns)
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 class PSClient:
-    """≙ BrpcPsClient: sticky connection, bulk verbs, bounded retries
-    (3-retry-then-raise ≙ ps_gpu_wrapper.cc:388-419)."""
+    """≙ BrpcPsClient: sticky connection, bulk verbs, retries with
+    exponential backoff + jitter under a deadline budget; non-idempotent
+    verbs ride the rid/dedup exactly-once protocol so EVERY verb retries
+    safely (the reference's 3-retry-then-fail, ps_gpu_wrapper.cc:388-419,
+    upgraded).  ``retries=None`` means attempt-unbounded (deadline-bounded
+    only)."""
 
-    def __init__(self, addr: Tuple[str, int], retries: int = 3,
-                 retry_sleep: float = 0.5,
-                 max_frame: int = wire.MAX_FRAME):
+    def __init__(self, addr: Tuple[str, int], retries: Optional[int] = 3,
+                 retry_sleep: float = 0.1,
+                 max_frame: int = wire.MAX_FRAME,
+                 deadline: float = 60.0, backoff_cap: float = 2.0):
         self.addr = tuple(addr)
         self.retries = retries
-        self.retry_sleep = retry_sleep
+        self.retry_sleep = retry_sleep      # backoff base
+        self.backoff_cap = backoff_cap
+        self.deadline = deadline            # per-call retry budget (s)
         # soft frame budget for transparent chunking of the row verbs
         # (≙ brpc_ps_client splitting a bulk request over shard requests):
         # callers never split by hand; a whole-pass pull through
@@ -298,6 +558,21 @@ class PSClient:
         self._row_bytes_est: Dict[str, int] = {}
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # rid = token ":" seq — unique per client instance, monotonic
+        self._token = f"c{os.getpid():x}-{os.urandom(4).hex()}"
+        self._seq = 0
+
+    def _next_rid(self) -> str:
+        with self._lock:
+            self._seq += 1
+            return f"{self._token}:{self._seq}"
+
+    def new_rid_group(self) -> str:
+        """A stable id for a multi-chunk logical mutation: chunk i is sent
+        as rid ``<group>.<i>``, so a CALLER-level resend of the whole
+        logical verb (pass-level recovery) reuses the same rids and
+        already-applied chunks dedup server-side."""
+        return self._next_rid()
 
     def _per_chunk(self, bytes_per_row: int) -> int:
         """Keys per frame so each stays well under max_frame (4x headroom
@@ -324,39 +599,68 @@ class PSClient:
             tot += a.dtype.itemsize * (int(np.prod(a.shape[1:])) or 1)
         return tot
 
+    def _drop_sock(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
     def _call(self, req: Dict, retry: bool = True,
-              timeout: float = 60) -> Dict:
-        """retry=False for non-idempotent verbs (delta merges, barrier):
-        a resend after an ambiguous failure could apply twice — fail loud
-        and let the pass-level recovery decide."""
-        last_err = None
-        for _ in range(self.retries if retry else 1):
+              timeout: float = 60, deadline: Optional[float] = None,
+              dedup: bool = False) -> Dict:
+        """One verb round-trip with retries.
+
+        ``dedup=True`` stamps a fresh rid (or the caller presets
+        wire.RID_FIELD itself for chunk groups): the server's dedup window
+        makes the resend of an applied-but-unacknowledged mutation return
+        the cached response — exactly-once, so even barrier/allreduce/
+        delta verbs retry safely.  Backoff is exponential with jitter
+        under ``deadline`` (default: the client's budget); the connect
+        timeout honors the per-call ``timeout`` and never outlives the
+        remaining budget."""
+        if dedup and wire.RID_FIELD not in req:
+            req = dict(req)
+            req[wire.RID_FIELD] = self._next_rid()
+        rid = req.get(wire.RID_FIELD)
+        bo = Backoff(base=self.retry_sleep, cap=self.backoff_cap,
+                     deadline=self.deadline if deadline is None
+                     else deadline)
+        attempt = 0
+        while True:
             try:
                 with self._lock:
                     if self._sock is None:
+                        if faults.ACTIVE is not None:
+                            faults.on_connect("client")
+                        rem = bo.remaining()
+                        cto = timeout if rem is None else \
+                            max(min(timeout, rem), 0.001)
                         self._sock = socket.create_connection(self.addr,
-                                                              timeout=60)
+                                                              timeout=cto)
                     self._sock.settimeout(timeout)
-                    _send(self._sock, req)
-                    resp = _recv(self._sock)
+                    _send(self._sock, req, role="client")
+                    resp = _recv(self._sock, role="client")
+                if rid is not None and resp.get(wire.RID_FIELD, rid) != rid:
+                    # a frame from a previous (timed-out) request surfaced
+                    # on a reused stream — resync by reconnecting
+                    raise ConnectionError("stale response (rid mismatch)")
                 if not resp.get("ok"):
                     raise RuntimeError(resp.get("error", "ps error"))
                 return resp
             except (ConnectionError, OSError) as e:
-                last_err = e
-                with self._lock:
-                    if self._sock is not None:
-                        try:
-                            self._sock.close()
-                        except OSError:
-                            pass
-                        self._sock = None
-                if not retry:
+                self._drop_sock()
+                attempt += 1
+                stat_add("ps.client.retry")
+                exhausted = (self.retries is not None
+                             and attempt >= self.retries)
+                if not retry or exhausted or not bo.sleep(attempt):
+                    stat_add("ps.client.give_up")
                     raise ConnectionError(
-                        f"ps call {req.get('cmd')!r} failed (not retried — "
-                        f"non-idempotent): {last_err}") from e
-                time.sleep(self.retry_sleep)
-        raise ConnectionError(f"ps unreachable after retries: {last_err}")
+                        f"ps call {req.get('cmd')!r} failed after "
+                        f"{attempt} attempt(s): {e}") from e
 
     # -- verbs (table=None → the default table) -----------------------------
     def pull_sparse(self, keys: np.ndarray, table: Optional[str] = None,
@@ -408,29 +712,34 @@ class PSClient:
     def push_sparse_delta(self, keys: np.ndarray,
                           rows: Dict[str, np.ndarray],
                           rows_abs: Optional[Dict[str, np.ndarray]] = None,
-                          table: Optional[str] = None):
-        # chunked like push_sparse; each chunk stays non-idempotent (no
-        # retry) — a mid-sequence failure leaves earlier chunks applied,
-        # the same partial-application contract a single oversized frame
-        # already had at the pass level
+                          table: Optional[str] = None,
+                          rid_group: Optional[str] = None):
+        """Chunked like push_sparse.  Each chunk carries rid
+        ``<group>.<i>`` so resends — in-call retries AND a caller-level
+        replay of the whole logical push with the same ``rid_group``
+        (pass-level recovery after a mid-sequence failure) — apply
+        exactly once; already-applied chunks return the cached ack."""
         keys = np.asarray(keys)
         rows_abs = rows_abs or {}
+        group = rid_group or self.new_rid_group()
         per_row = self._rows_bytes(rows) + self._rows_bytes(rows_abs)
-        for lo, c in self._chunk_counts(len(keys), per_row):
+        for i, (lo, c) in enumerate(
+                self._chunk_counts(len(keys), per_row)):
             self._call({"cmd": "push_sparse_delta",
                         "keys": keys[lo:lo + c],
                         "rows": {f: np.asarray(v)[lo:lo + c]
                                  for f, v in rows.items()},
                         "rows_abs": {f: np.asarray(v)[lo:lo + c]
                                      for f, v in rows_abs.items()},
-                        "table": table}, retry=False)
+                        "table": table,
+                        wire.RID_FIELD: f"{group}.{i}"})
 
     def pull_dense(self, name: str) -> Optional[np.ndarray]:
         return self._call({"cmd": "pull_dense", "name": name})["value"]
 
     def push_dense(self, name: str, value: np.ndarray, add: bool = False):
         self._call({"cmd": "push_dense", "name": name,
-                    "value": np.asarray(value), "add": add})
+                    "value": np.asarray(value), "add": add}, dedup=True)
 
     def save(self, path: str, mode: str = "all",
              table: Optional[str] = None) -> int:
@@ -445,7 +754,8 @@ class PSClient:
         return self._call({"cmd": "shrink", "table": table})["removed"]
 
     def end_day(self, table: Optional[str] = None) -> None:
-        self._call({"cmd": "end_day", "table": table})
+        # non-idempotent (counter decay) → exactly-once via rid
+        self._call({"cmd": "end_day", "table": table}, dedup=True)
 
     def size(self, table: Optional[str] = None) -> int:
         return self._call({"cmd": "size", "table": table})["size"]
@@ -453,20 +763,28 @@ class PSClient:
     def list_tables(self) -> Dict[str, int]:
         return self._call({"cmd": "list_tables"})["tables"]
 
+    def health(self, timeout: float = 5.0) -> Dict:
+        """Heartbeat: liveness + drain state, cheap enough to poll."""
+        return self._call({"cmd": "health"}, timeout=timeout,
+                          deadline=timeout)
+
     def barrier(self, world: int, timeout: float = 120) -> None:
-        # no retry (a resend would double-register this participant) and a
-        # client timeout LONGER than the server's wait window, so the
-        # server side always resolves (release or rollback) first
-        self._call({"cmd": "barrier", "world": world}, retry=False,
-                   timeout=timeout)
+        # retryable via rid: a resend after a dropped connection WAITS on
+        # the original registration server-side instead of double-
+        # registering.  Client timeout stays LONGER than the server's wait
+        # window, so the server side always resolves (release or
+        # rollback) first.
+        self._call({"cmd": "barrier", "world": world}, timeout=timeout,
+                   deadline=2 * timeout, dedup=True)
 
     def allreduce(self, arrs: Dict[str, np.ndarray], world: int, key: str,
                   timeout: float = 120) -> Dict[str, np.ndarray]:
         """Sum the named arrays across `world` workers (every caller gets
-        the same result).  Non-idempotent like barrier — no retry.  Use a
-        fresh key per collective (e.g. f"auc-{pass_id}")."""
+        the same result).  Exactly-once like barrier (rid-dedup'd resend).
+        Use a fresh key per collective (e.g. f"auc-{pass_id}")."""
         out = self._call({"cmd": "allreduce", "key": key, "world": world,
-                          "arrs": dict(arrs)}, retry=False, timeout=timeout)
+                          "arrs": dict(arrs)}, timeout=timeout,
+                         deadline=2 * timeout, dedup=True)
         return out["arrs"]
 
 
@@ -480,7 +798,12 @@ class RemoteTableAdapter:
     worker shares one base), bulk_write sends (new - snapshot) and the
     server SUMS concurrent workers' deltas — pass-granular Hogwild, the
     pass-lifecycle analogue of multi-node sparse grad aggregation
-    (heter_comm_inl.h:2027/2131)."""
+    (heter_comm_inl.h:2027/2131).
+
+    Pass-level recovery: a failed write-back restores the pull snapshot
+    AND pins the chunk rid-group, so re-driving end_pass resends byte-
+    identical chunks under the same rids — chunks that DID land before the
+    failure dedup server-side instead of double-applying."""
 
     def __init__(self, client: PSClient, table: Optional[str] = None,
                  delta_mode: bool = False):
@@ -491,6 +814,7 @@ class RemoteTableAdapter:
         # sites (pass build, async preload of the NEXT pass, stale-row
         # refresh) and a single slot would be clobbered before write-back
         self._snaps: Dict[bytes, Dict[str, np.ndarray]] = {}
+        self._snap_groups: Dict[bytes, str] = {}
         self._snap_cap = 4
 
     def bulk_pull(self, keys):
@@ -499,7 +823,19 @@ class RemoteTableAdapter:
         if self.delta_mode:
             digest = np.asarray(keys, np.uint64).tobytes()
             if len(self._snaps) >= self._snap_cap:
-                self._snaps.pop(next(iter(self._snaps)))  # oldest out
+                old = next(iter(self._snaps))       # oldest out
+                self._snaps.pop(old)
+                self._snap_groups.pop(old, None)
+                # loud at the CAUSE: the silent eviction used to surface
+                # later as a confusing no-matching-snapshot RuntimeError
+                # at write-back time, far from here
+                logging.getLogger(__name__).warning(
+                    "RemoteTableAdapter: pull-snapshot cap (%d) hit — "
+                    "evicting the oldest snapshot (%d keys); a later "
+                    "write-back of that key set will fail. More "
+                    "concurrent pulls in flight than _snap_cap?",
+                    self._snap_cap, len(old) // 8)
+                stat_add("ps.adapter.snap_evict")
             self._snaps[digest] = {f: np.array(v, copy=True)
                                    for f, v in rows.items()}
         return rows
@@ -546,8 +882,20 @@ class RemoteTableAdapter:
                  and not self._is_abs(f)}
         rows_abs = {f: np.asarray(v) for f, v in soa.items()
                     if self._is_abs(f)}
-        self.client.push_sparse_delta(keys, delta, rows_abs=rows_abs,
-                                      table=self.table)
+        group = self._snap_groups.pop(digest, None) or \
+            self.client.new_rid_group()
+        try:
+            self.client.push_sparse_delta(keys, delta, rows_abs=rows_abs,
+                                          table=self.table, rid_group=group)
+        except Exception:
+            # pass-level recovery: restore the snapshot and PIN the rid
+            # group — a re-driven end_pass resends identical chunks under
+            # identical rids, so chunks that landed dedup instead of
+            # double-applying
+            self._snaps[digest] = snap
+            self._snap_groups[digest] = group
+            stat_add("ps.adapter.writeback_retry_armed")
+            raise
 
     def end_day(self):
         self.client.end_day(table=self.table)

@@ -21,6 +21,13 @@ Frame layout (all little-endian):
       ndarray -> u8 dtype-len + dtype.str ascii, u8 ndim, u64*ndim shape,
                  raw C-order bytes
       dict  -> nested encoding (depth limited to 1 nesting level)
+
+Request ids: retryable non-idempotent requests carry a conventional
+string field ``RID_FIELD`` ("rid") of the form ``<client-token>:<seq>``
+(chunked verbs suffix ``.<chunk>``); the server echoes it on the matching
+response and dedups resends through its bounded window (ps/service.py
+_DedupWindow).  The echo also lets a client reject a stale frame that
+surfaces on a reused stream after a timeout.
 """
 
 from __future__ import annotations
@@ -34,6 +41,10 @@ MAX_FRAME = 1 << 32          # hard cap: one frame can't ask for >4 GiB
 MAX_FIELDS = 4096
 MAX_KEY = 1 << 16
 _MAX_NDIM = 16
+
+# exactly-once request-id field (see module docstring): service.py stamps
+# it on mutating requests and echoes it on responses
+RID_FIELD = "rid"
 
 
 class DecodeError(ValueError):

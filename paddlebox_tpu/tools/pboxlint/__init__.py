@@ -10,6 +10,7 @@ interpreter, so this package supplies the equivalent as lint passes over
   PB2xx  flag hygiene           (tools/pboxlint/flags_hygiene.py)
   PB3xx  JAX purity             (tools/pboxlint/purity.py)
   PB4xx  threading lifecycle    (tools/pboxlint/lifecycle.py)
+  PB5xx  retry/backoff          (tools/pboxlint/retries.py)
 
 CLI::
 

@@ -1,0 +1,55 @@
+"""PB5xx — retry/backoff discipline.
+
+  PB501  a constant-argument ``time.sleep`` inside a retry loop (a
+         ``for``/``while`` whose body contains a ``try`` with an
+         exception handler) — a fixed sleep bypasses the shared backoff
+         helper (utils/backoff.Backoff): no exponential growth, no
+         jitter (a fleet of clients retries in lockstep), and no overall
+         deadline budget.  A sleep of a *computed* value (the helper's
+         own ``bo.sleep(attempt)``, a variable, an attribute) is not
+         flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
+                                               PackageContext, dotted_name)
+
+
+def _is_const_sleep(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    if name not in ("time.sleep", "sleep"):
+        return False
+    if not node.args or node.keywords:
+        return False
+    arg = node.args[0]
+    return (isinstance(arg, ast.Constant)
+            and isinstance(arg.value, (int, float))
+            and not isinstance(arg.value, bool))
+
+
+def check(mod: Module, ctx: PackageContext) -> List[Finding]:
+    findings: List[Finding] = []
+    flagged: Set[int] = set()       # nested loops: report each sleep once
+    for loop in ast.walk(mod.tree):
+        if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        if not any(isinstance(n, ast.Try) and n.handlers
+                   for n in ast.walk(loop)):
+            continue                # not a retry loop — plain polling
+        for node in ast.walk(loop):
+            if _is_const_sleep(node) and node.lineno not in flagged:
+                flagged.add(node.lineno)
+                findings.append(Finding(
+                    mod.path, node.lineno, "PB501",
+                    "fixed-sleep retry loop: constant time.sleep() "
+                    "inside a loop with an exception handler bypasses "
+                    "the shared backoff helper — use utils/backoff."
+                    "Backoff (exponential + jitter under a deadline "
+                    "budget)"))
+    return findings

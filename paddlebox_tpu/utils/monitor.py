@@ -34,9 +34,14 @@ class StatRegistry:
         with self._lock:
             return self._stats.get(name, 0.0)
 
-    def snapshot(self) -> Dict[str, float]:
+    def snapshot(self, prefix: str = "") -> Dict[str, float]:
+        """All stats, or just those under a dotted prefix (e.g.
+        ``snapshot("ps.fault")`` → every injected-fault counter)."""
         with self._lock:
-            return dict(self._stats)
+            if not prefix:
+                return dict(self._stats)
+            return {k: v for k, v in self._stats.items()
+                    if k.startswith(prefix)}
 
     def reset(self) -> None:
         with self._lock:
@@ -49,3 +54,7 @@ def stat_add(name: str, value: float = 1.0) -> None:
 
 def stat_get(name: str) -> float:
     return StatRegistry.instance().get(name)
+
+
+def stat_snapshot(prefix: str = "") -> Dict[str, float]:
+    return StatRegistry.instance().snapshot(prefix)

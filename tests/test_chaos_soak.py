@@ -1,0 +1,222 @@
+"""Chaos soak: a multi-day, multi-pass day workflow driven through seeded
+network chaos (connection drops, delays, truncated frames, one mid-verb
+server kill) must converge to a table state BIT-IDENTICAL to the
+fault-free run — the acceptance gate of the exactly-once retry protocol.
+Zero duplicate delta application is verified both by the exact equality
+and by the dedup-hit counters.
+
+The fast variant (tier-1) drives the in-process fault hooks; the full
+soak (marked slow) runs 2 days x 3 passes through the ChaosProxy with a
+probabilistic schedule plus a scheduled kill + same-port restart.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import flags
+from paddlebox_tpu.config import EmbeddingTableConfig
+from paddlebox_tpu.ps import faults
+from paddlebox_tpu.ps.host_table import ShardedHostTable
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.ps.service import PSClient, PSServer, RemoteTableAdapter
+from paddlebox_tpu.utils.monitor import StatRegistry, stat_get
+
+CFG = dict(embedding_dim=4, shard_num=4)
+PREAMBLE_KEYS = np.array([999_001, 999_002], np.uint64)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    StatRegistry.instance().reset()
+    flags.set_flags({"ps_fault_injection": True})
+    yield
+    faults.uninstall()
+    flags.set_flags({"ps_fault_injection": False})
+
+
+def _pass_keys(day: int, p: int) -> np.ndarray:
+    """Deterministic, overlapping key sets per (day, pass)."""
+    rng = np.random.default_rng(1000 * day + p)
+    return np.unique(rng.integers(1, 400, size=120).astype(np.uint64))
+
+
+def _run_workflow(client: PSClient, days: int, passes: int) -> None:
+    engine = BoxPSEngine(EmbeddingTableConfig(**CFG))
+    engine.table = RemoteTableAdapter(client, delta_mode=True)
+    for day in range(days):
+        engine.set_date(f"2026080{day + 1}")
+        for p in range(passes):
+            engine.begin_feed_pass()
+            engine.add_keys(_pass_keys(day, p))
+            engine.end_feed_pass()
+            engine.begin_pass()
+            # deterministic "training": exact adds → a fault-free replay
+            # reproduces the arithmetic bit-for-bit
+            engine.ws["show"] = engine.ws["show"] + float(p + 1)
+            engine.ws["click"] = engine.ws["click"] + 1.0
+            engine.ws["mf"] = engine.ws["mf"] + 0.5
+            engine.end_pass()
+            client.barrier(1, timeout=30)
+            out = client.allreduce({"x": np.ones(3)}, 1,
+                                   key=f"ar-{day}-{p}", timeout=30)
+            np.testing.assert_allclose(out["x"], np.ones(3))
+
+
+def _preamble(client: PSClient) -> None:
+    """One delta push whose ack the chaos schedule is aimed at — run in
+    BOTH the baseline and the chaos run so states stay comparable."""
+    rows = client.pull_sparse(PREAMBLE_KEYS, create=True)
+    d = {f: np.zeros_like(v) for f, v in rows.items()}
+    d["show"] = np.ones(len(PREAMBLE_KEYS), np.float32)
+    client.push_sparse_delta(PREAMBLE_KEYS, d)
+
+
+def _all_keys(days: int, passes: int) -> np.ndarray:
+    parts = [PREAMBLE_KEYS]
+    for day in range(days):
+        for p in range(passes):
+            parts.append(_pass_keys(day, p))
+    return np.unique(np.concatenate(parts))
+
+
+def _state(table: ShardedHostTable, keys: np.ndarray):
+    return table.bulk_pull(keys)
+
+
+def _assert_bit_identical(a, b):
+    assert set(a) == set(b)
+    for f in a:
+        np.testing.assert_array_equal(a[f], b[f], err_msg=f"field {f!r}")
+
+
+def _baseline(days: int, passes: int):
+    table = ShardedHostTable(EmbeddingTableConfig(**CFG), seed=0)
+    srv = PSServer(table)
+    try:
+        client = PSClient(srv.addr)
+        _preamble(client)
+        _run_workflow(client, days, passes)
+        return _state(table, _all_keys(days, passes))
+    finally:
+        srv.shutdown()
+
+
+def test_inprocess_chaos_day_is_bit_identical():
+    """Tier-1 fast case: 1 day x 2 passes over the in-process hooks with
+    scheduled drops (client send, server response, recv) and delays."""
+    days, passes = 1, 2
+    want = _baseline(days, passes)
+
+    table = ShardedHostTable(EmbeddingTableConfig(**CFG), seed=0)
+    srv = PSServer(table)
+    try:
+        client = PSClient(srv.addr, retries=None, retry_sleep=0.01,
+                          backoff_cap=0.1, deadline=30)
+        _preamble(client)           # pulls once before the plan arms
+        faults.install(
+            faults.FaultPlan(seed=11)
+            .drop("send", role="server", at=(1,))    # the delta ACK below
+            .drop("send", role="client", at=(2, 6))
+            .drop("recv", role="client", at=(4,))
+            .drop("dispatch", role="server", cmd="push_sparse_delta",
+                  at=(3,))
+            .delay("send", 0.002, role="client", prob=0.1))
+        # re-push the preamble delta: its ack is the first server send →
+        # dropped → the retry MUST dedup (applied-but-unacknowledged)
+        rows = client.pull_sparse(PREAMBLE_KEYS)
+        _ = rows
+        d = {f: np.zeros_like(v) for f, v in rows.items()}
+        client.push_sparse_delta(PREAMBLE_KEYS, d)   # zero delta, acked once
+        _run_workflow(client, days, passes)
+        faults.uninstall()
+        got = _state(table, _all_keys(days, passes))
+    finally:
+        faults.uninstall()
+        srv.shutdown()
+
+    _assert_bit_identical(want, got)
+    assert stat_get("ps.server.dedup_hit") >= 1      # zero duplicate apply
+    assert stat_get("ps.client.retry") >= 3
+    assert stat_get("ps.fault.send.drop") >= 3
+
+
+def _chaos_baseline_vs_run(days, passes, kill_at):
+    """Shared body of the full soak: baseline, then the chaos run through
+    a proxy + in-process kill schedule; returns (want, got, plan, kplan)."""
+    want = _baseline(days, passes)
+
+    table = ShardedHostTable(EmbeddingTableConfig(**CFG), seed=0)
+    srv = PSServer(table)
+    port = srv.addr[1]
+    noise = (faults.FaultPlan(seed=29)
+             .drop("connect", role="proxy", prob=0.05)
+             .drop("send", role="proxy", prob=0.04)
+             .drop("recv", role="proxy", prob=0.04)
+             .truncate("send", role="proxy", prob=0.01)
+             .truncate("recv", role="proxy", prob=0.01)
+             .delay("send", 0.003, role="proxy", prob=0.15))
+    proxy = faults.ChaosProxy(srv.addr, noise)
+    restarted = []
+
+    def restarter(kplan):
+        kplan.killed.wait(timeout=120)
+        if not kplan.killed.is_set():
+            return
+        time.sleep(0.3)
+        restarted.append(PSServer(table, port=port))
+
+    try:
+        client = PSClient(proxy.addr, retries=None, retry_sleep=0.01,
+                          backoff_cap=0.15, deadline=60)
+        _preamble(client)
+        # in-process plan: one applied-but-unacked ack drop (forces a
+        # dedup hit) + the mid-verb server kill
+        kplan = (faults.FaultPlan(seed=5)
+                 .drop("send", role="server", at=(1,))
+                 .kill_server(cmd="push_sparse_delta", at=kill_at))
+        faults.install(kplan)
+        rows = client.pull_sparse(PREAMBLE_KEYS)
+        d = {f: np.zeros_like(v) for f, v in rows.items()}
+        client.push_sparse_delta(PREAMBLE_KEYS, d)   # ack dropped → dedup
+        watcher = threading.Thread(target=restarter, args=(kplan,),
+                                   daemon=True)
+        watcher.start()
+        _run_workflow(client, days, passes)
+        faults.uninstall()
+        watcher.join(timeout=10)
+        got = _state(table, _all_keys(days, passes))
+        return want, got, noise, kplan
+    finally:
+        faults.uninstall()
+        proxy.shutdown()
+        for s in restarted:
+            s.shutdown()
+        srv.shutdown()
+
+
+@pytest.mark.slow
+def test_chaos_soak_two_days_bit_identical():
+    """The full acceptance soak: 2 days x 3 passes through the chaos
+    proxy (seeded probabilistic drops/delays/truncations) plus one
+    mid-verb server kill with a same-port restart — final table state is
+    bit-identical to the fault-free baseline."""
+    want, got, noise, kplan = _chaos_baseline_vs_run(
+        days=2, passes=3, kill_at=(4,))
+    _assert_bit_identical(want, got)
+    assert kplan.killed.is_set()                     # the kill really fired
+    assert stat_get("ps.server.dedup_hit") >= 1     # zero duplicate apply
+    assert stat_get("ps.client.retry") >= 1
+    assert noise.hits("send", "proxy") > 0
+
+
+@pytest.mark.slow
+def test_chaos_soak_replay_is_deterministic():
+    """Same seeds → the chaos run converges to the same exact state again
+    (the reproducibility half of the harness's contract)."""
+    _, got1, _, _ = _chaos_baseline_vs_run(days=1, passes=2, kill_at=(2,))
+    StatRegistry.instance().reset()
+    _, got2, _, _ = _chaos_baseline_vs_run(days=1, passes=2, kill_at=(2,))
+    _assert_bit_identical(got1, got2)

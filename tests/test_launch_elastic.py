@@ -195,9 +195,11 @@ def test_elastic_grow_after_spent_budget_keeps_job_alive(tmp_path):
     # at-cap grow request present from the start; budget zero
     with open(os.path.join(edir, "grow"), "w") as f:
         f.write("2")
+    # wide TTL: a gen bump here would mask the policy under test, and a
+    # loaded CI box can stall worker heartbeats for several seconds
     rc = launch_elastic(_WORKER, ["none"], nproc=2, elastic_dir=edir,
                         min_workers=1, max_relaunches=0,
-                        heartbeat_ttl=4.0)
+                        heartbeat_ttl=10.0)
     assert rc == 0
     final = _read_json(os.path.join(edir, "job_ckpt.json"))
     assert final["gen"] == 0 and final["world"] == 2

@@ -339,6 +339,79 @@ def test_pb402_queue_gated_loop_is_fine():
 
 # -- suppressions ------------------------------------------------------------
 
+# -- PB5xx retry/backoff discipline ------------------------------------------
+
+def test_pb501_fixed_sleep_retry_loop():
+    src = """
+    import time
+
+    def fetch(addr):
+        for _ in range(3):
+            try:
+                return connect(addr)
+            except ConnectionError:
+                time.sleep(0.5)
+    """
+    assert codes(src) == ["PB501"]
+
+
+def test_pb501_while_loop_and_bare_sleep_name():
+    src = """
+    from time import sleep
+
+    def poll():
+        while True:
+            try:
+                return check()
+            except OSError:
+                sleep(2)
+    """
+    assert codes(src) == ["PB501"]
+
+
+def test_pb501_negative_computed_sleep_and_backoff_helper():
+    # non-constant sleeps (variables, attributes, the shared helper) are
+    # the sanctioned patterns; a constant sleep in a try-less poll loop
+    # is polling, not retrying
+    src = """
+    import time
+    from paddlebox_tpu.utils.backoff import Backoff
+
+    def fetch(self, addr):
+        bo = Backoff(base=0.05, deadline=30)
+        attempt = 0
+        while True:
+            try:
+                return connect(addr)
+            except ConnectionError:
+                attempt += 1
+                if not bo.sleep(attempt):
+                    raise
+                time.sleep(self.retry_sleep)
+
+    def watch(procs):
+        while procs:
+            reap(procs)
+            time.sleep(0.2)
+    """
+    assert codes(src) == []
+
+
+def test_pb501_suppression_escape():
+    src = """
+    import time
+
+    def fetch(addr):
+        for _ in range(3):
+            try:
+                return connect(addr)
+            except ConnectionError:
+                # pboxlint: disable-next=PB501 -- vendor API mandates 1s
+                time.sleep(1.0)
+    """
+    assert codes(src) == []
+
+
 def test_suppression_same_line_and_next_line():
     base = """
     import threading
