@@ -110,8 +110,12 @@ class TcpShuffleTransport(ShuffleTransport):
         self._done_from = set()
         self._done_lock = threading.Lock()
         self._done_cv = threading.Condition(self._done_lock)
+        # _conn_lock guards the registries only (PB104: never frame I/O);
+        # per-destination send locks serialize frames on ONE peer's socket
+        # without stalling senders to OTHER peers behind a global lock
         self._conns: Dict[int, socket.socket] = {}
         self._conn_lock = threading.Lock()
+        self._send_locks: Dict[int, threading.Lock] = {}
 
         host, port = self._addrs[rank]
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -168,16 +172,33 @@ class TcpShuffleTransport(ShuffleTransport):
 
     def _conn_to(self, dst: int) -> socket.socket:
         with self._conn_lock:
-            if dst not in self._conns:
-                s = socket.create_connection(self._addrs[dst], timeout=30)
-                self._conns[dst] = s
-            return self._conns[dst]
+            sock = self._conns.get(dst)
+        if sock is not None:
+            return sock
+        # dial OUTSIDE the lock; on a connect race the loser's socket
+        # closes and everyone converges on the registered one
+        s = socket.create_connection(self._addrs[dst], timeout=30)
+        with self._conn_lock:
+            cur = self._conns.setdefault(dst, s)
+        if cur is not s:
+            try:
+                s.close()
+            except OSError:
+                pass
+        return cur
+
+    def _send_lock(self, dst: int) -> threading.Lock:
+        with self._conn_lock:
+            lk = self._send_locks.get(dst)
+            if lk is None:
+                lk = self._send_locks[dst] = threading.Lock()
+            return lk
 
     # ------------------------------------------------------------------
     def send(self, dst: int, block: SlotRecordBlock) -> None:
         payload = block_to_wire(block)
         sock = self._conn_to(dst)
-        with self._conn_lock:
+        with self._send_lock(dst):
             _send_msg(sock, _MSG_BLOCK, payload)
 
     def barrier(self) -> None:
@@ -188,7 +209,7 @@ class TcpShuffleTransport(ShuffleTransport):
             if dst == self._rank:
                 continue
             sock = self._conn_to(dst)
-            with self._conn_lock:
+            with self._send_lock(dst):
                 _send_msg(sock, _MSG_DONE, me)
         with self._done_cv:
             while len(self._done_from) < self._world - 1:

@@ -311,9 +311,33 @@ def main():
                          "train through injected connection chaos — the "
                          "multi-process face of the chaos soak suite")
     ap.add_argument("--chaos_seed", type=int, default=0)
+    # PS wire-path knobs, exported to every worker as FLAGS_* env (the
+    # flag registry reads FLAGS_<name> at import): pipelined pull/push
+    # stream pool, in-flight window, and payload quantization
+    ap.add_argument("--ps_streams", type=int, default=None,
+                    help="workers' PSClient connection-pool size "
+                         "(FLAGS_ps_streams; 1 = stop-and-wait)")
+    ap.add_argument("--ps_window", type=int, default=None,
+                    help="max chunk frames in flight per pipelined verb "
+                         "(FLAGS_ps_window)")
+    ap.add_argument("--ps_wire_dtype", default="",
+                    choices=("", "f32", "f16", "i8"),
+                    help="wire encoding of float32 PS row payloads "
+                         "(FLAGS_ps_wire_dtype; server state stays fp32)")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
+    # EXPORTS for the worker processes — set_flags() cannot cross the
+    # process boundary, the child's flag registry reads FLAGS_* at import
+    if args.ps_streams is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_ps_streams"] = str(args.ps_streams)
+    if args.ps_window is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_ps_window"] = str(args.ps_window)
+    if args.ps_wire_dtype:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_ps_wire_dtype"] = args.ps_wire_dtype
     proxy = None
     if args.chaos_backend:
         from paddlebox_tpu.ps.faults import ChaosProxy, FaultPlan

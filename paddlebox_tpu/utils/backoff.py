@@ -39,6 +39,13 @@ class Backoff:
             return None
         return self.deadline - (time.monotonic() - self._t0)
 
+    def reset(self) -> None:
+        """Restart the episode clock after PROGRESS: a long pipelined
+        transfer that keeps landing frames between reconnects should
+        measure its deadline from the last success, not from the first
+        attempt — only sustained lack of progress exhausts the budget."""
+        self._t0 = time.monotonic()
+
     def delay(self, attempt: int) -> float:
         """Jittered nominal delay for the given 1-based attempt number:
         ``min(cap, base * 2**(attempt-1)) * uniform(0.5, 1.0)``."""

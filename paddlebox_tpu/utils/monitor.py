@@ -30,6 +30,13 @@ class StatRegistry:
         with self._lock:
             self._stats[name] = value
 
+    def max(self, name: str, value: float) -> None:
+        """Keep the high-water mark of a gauge (e.g. frames in flight)."""
+        with self._lock:
+            cur = self._stats.get(name)
+            if cur is None or value > cur:
+                self._stats[name] = value
+
     def get(self, name: str) -> float:
         with self._lock:
             return self._stats.get(name, 0.0)
@@ -54,6 +61,10 @@ def stat_add(name: str, value: float = 1.0) -> None:
 
 def stat_get(name: str) -> float:
     return StatRegistry.instance().get(name)
+
+
+def stat_max(name: str, value: float) -> None:
+    StatRegistry.instance().max(name, value)
 
 
 def stat_snapshot(prefix: str = "") -> Dict[str, float]:

@@ -153,6 +153,25 @@ def test_supervisor_recovers_from_transient_hang(tmp_path):
     assert line["stage"] == "smoke"
 
 
+def test_supervisor_falls_back_to_cpu_after_wedge():
+    """BENCH_r05 failure mode: a persistently wedged accelerator platform
+    ate all 10 attempts and the round recorded 0.0.  After the FIRST
+    wedged attempt the supervisor must fall back to JAX_PLATFORMS=cpu so
+    later attempts reach a live backend.  BENCH_TEST_FAIL_AFTER_INIT
+    stops the run right after backend-up (twice → deterministic-failure
+    early exit), keeping the test fast while proving the fallback child
+    really initialized a cpu backend."""
+    line, err = _run_bench({
+        "BENCH_TEST_HANG_UNLESS_CPU": "1",
+        "BENCH_TEST_FAIL_AFTER_INIT": "post-fallback-marker",
+        "BENCH_BACKEND_ATTEMPT_S": "5",
+        "BENCH_TIMEOUT_S": "150"}, timeout=170)
+    assert "falling back to JAX_PLATFORMS=cpu" in err
+    assert "backend up: cpu" in err                 # fallback reached a backend
+    assert line.get("platform_fallback") == "cpu"
+    assert "post-fallback-marker" in line.get("error", "")
+
+
 def test_better_prefers_clean_full_over_higher_value_smoke(bench):
     smoke = {"metric": bench.METRIC, "value": 9999.0, "stage": "smoke"}
     full = {"metric": bench.METRIC, "value": 1200.0, "stage": "full"}

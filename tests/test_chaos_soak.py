@@ -143,6 +143,47 @@ def test_inprocess_chaos_day_is_bit_identical():
     assert stat_get("ps.fault.send.drop") >= 3
 
 
+def test_inprocess_chaos_day_pipelined_bit_identical():
+    """Pipelining composes with exactly-once: the same chaos-day contract
+    with a 4-stream client and a frame budget small enough that every
+    pass pull and delta push really pipelines multi-chunk windows.
+    Scheduled drops sever streams mid-window; requeued chunks resend via
+    the dedup window, and the final state stays bit-identical to the
+    fault-free (default, stop-and-wait) baseline."""
+    days, passes = 1, 2
+    want = _baseline(days, passes)
+
+    table = ShardedHostTable(EmbeddingTableConfig(**CFG), seed=0)
+    srv = PSServer(table)
+    try:
+        client = PSClient(srv.addr, retries=None, retry_sleep=0.01,
+                          backoff_cap=0.1, deadline=30,
+                          max_frame=1 << 13, streams=4, window=8)
+        _preamble(client)           # pulls once before the plan arms
+        faults.install(
+            faults.FaultPlan(seed=23)
+            .drop("send", role="server", at=(1,))    # applied-unacked ack
+            .drop("send", role="client", at=(3, 11))
+            .drop("recv", role="client", at=(6,))
+            .drop("dispatch", role="server", cmd="push_sparse_delta",
+                  at=(2,))
+            .delay("send", 0.001, role="client", prob=0.05))
+        rows = client.pull_sparse(PREAMBLE_KEYS)
+        d = {f: np.zeros_like(v) for f, v in rows.items()}
+        client.push_sparse_delta(PREAMBLE_KEYS, d)   # ack dropped → dedup
+        _run_workflow(client, days, passes)
+        faults.uninstall()
+        got = _state(table, _all_keys(days, passes))
+    finally:
+        faults.uninstall()
+        srv.shutdown()
+
+    _assert_bit_identical(want, got)
+    assert stat_get("ps.server.dedup_hit") >= 1      # zero duplicate apply
+    assert stat_get("ps.client.retry") >= 2
+    assert stat_get("ps.client.inflight_hwm") > 1    # windows really open
+
+
 def _chaos_baseline_vs_run(days, passes, kill_at):
     """Shared body of the full soak: baseline, then the chaos run through
     a proxy + in-process kill schedule; returns (want, got, plan, kplan)."""

@@ -140,6 +140,98 @@ def test_pb103_bare_acquire_without_try_finally():
     assert codes(src) == ["PB103"]
 
 
+def test_pb104_pre_fix_psclient_call_snippet():
+    """The regression canary: the PRE-PIPELINING PSClient._call held the
+    client-wide lock across connect/send/recv — exactly what the
+    multi-stream wire path removed.  PB104 must keep catching it."""
+    src = """
+    import socket
+    import threading
+
+    def _send(sock, msg):
+        sock.sendall(msg)
+
+    def _recv(sock):
+        return sock.recv(8)
+
+    class PSClient:
+        def __init__(self, addr):
+            self.addr = addr
+            self._sock = None
+            self._lock = threading.Lock()
+
+        def _call(self, req, timeout=60):
+            with self._lock:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        self.addr, timeout=timeout)
+                self._sock.settimeout(timeout)
+                _send(self._sock, req)
+                return _recv(self._sock)
+    """
+    got = codes(src)
+    assert got.count("PB104") == 3      # create_connection, _send, _recv
+
+
+def test_pb104_module_level_lock_and_open():
+    src = """
+    import threading
+    _LOCK = threading.Lock()
+
+    def bad(path):
+        with _LOCK:
+            with open(path) as f:
+                return f.read()
+
+    def good(path):
+        with open(path) as f:
+            data = f.read()
+        with _LOCK:
+            return data
+    """
+    assert codes(src) == ["PB104"]
+
+
+def test_pb104_negative_nested_def_and_io_outside_lock():
+    # a def statement under a lock does not RUN under the lock; I/O after
+    # the with-block is free; a condition-variable wait is not I/O
+    src = """
+    import socket
+    import threading
+
+    class C:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self._sock = socket.socket()
+
+        def spawn(self):
+            with self._cv:
+                def worker():
+                    self._sock.sendall(b"x")
+                self._cv.wait(1.0)
+            self._sock.sendall(b"y")
+            return worker
+    """
+    assert codes(src) == []
+
+
+def test_pb104_suppression():
+    src = """
+    import threading
+
+    class Log:
+        def __init__(self, path):
+            self.path = path
+            self._lock = threading.Lock()
+
+        def append(self, rec):
+            # pboxlint: disable-next=PB104 -- the file IS the locked thing
+            with self._lock, open(self.path, "ab") as fh:
+                fh.write(rec)
+    """
+    assert codes(src) == []
+
+
 # -- PB2xx flag hygiene ------------------------------------------------------
 
 def test_pb201_unregistered_flag_name():
