@@ -144,6 +144,21 @@ def _watchdog() -> None:
             os._exit(0)
 
 
+def _obs_snapshot():
+    """End-of-run observability snapshot (wire bytes, stall seconds,
+    inflight hwm, latency-histogram percentiles) embedded in the result
+    line — the perf trajectory carries CAUSES, not just numbers."""
+    try:
+        from paddlebox_tpu.utils.monitor import stat_snapshot
+        obs = {}
+        for prefix in ("ps.", "data.", "trainer."):
+            obs.update(stat_snapshot(prefix))
+        return {k: round(v, 6) if isinstance(v, float) else v
+                for k, v in sorted(obs.items())}
+    except Exception:  # diagnostics must never sink the result line
+        return {}
+
+
 def _init_devices(retries: int = 3, delay: float = 5.0):
     if os.environ.get("BENCH_TEST_HANG_INIT") == "1":
         # harness-test hook: simulate the round-4 tunnel wedge (a hang,
@@ -468,7 +483,8 @@ def run() -> None:
         emit(smoke["e2e"], final=smoke_only, basis="end_to_end",
              stage="smoke", device_step=round(smoke["device_step"], 1),
              backend=backend, batches=smoke["batches"],
-             compile_s=smoke["compile_s"])
+             compile_s=smoke["compile_s"],
+             **({"obs_stats": _obs_snapshot()} if smoke_only else {}))
         if smoke_only:
             return
         if os.environ.get("BENCH_TEST_DIE_AFTER_SMOKE") == "1":
@@ -484,7 +500,8 @@ def run() -> None:
          auc=full["auc"], backend=backend, pack_threads=PACK_THREADS,
          compile_s=full["compile_s"], pass_pack_s=full["pass_pack_s"],
          amp=full["amp"], step_ms=full["step_ms"],
-         trim_frac=full["trim_frac"], timers=full["timers"])
+         trim_frac=full["trim_frac"], timers=full["timers"],
+         obs_stats=_obs_snapshot())
 
 
 def child_main() -> None:
@@ -495,7 +512,7 @@ def child_main() -> None:
         trace(f"FAILED in phase {_STATE['phase']}: {type(e).__name__}: {e}")
         emit(_best(), final=True, error=f"{type(e).__name__}: {e}",
              last_phase=_STATE["phase"],
-             partial=dict(_STATE["partial"]))
+             partial=dict(_STATE["partial"]), obs_stats=_obs_snapshot())
         # exit 0: the driver must always find a parseable JSON line
     finally:
         with _LOCK:

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -36,6 +37,7 @@ import jax.numpy as jnp
 from paddlebox_tpu.config import DataFeedConfig, SlotConfig
 from paddlebox_tpu.data.batch_pack import BatchPacker
 from paddlebox_tpu.data.slot_record import SlotRecordBlock
+from paddlebox_tpu.utils.monitor import stat_observe
 
 
 @dataclasses.dataclass
@@ -98,6 +100,7 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
     (dataset.batch_bounds) — no per-batch block copies needed.  Otherwise
     blocks are concatenated and sliced densely every batch_size records.
     """
+    t_pack = time.perf_counter()
     packer = BatchPacker(feed_config, batch_size, label_slot)
     blocks = list(blocks)
     merged = SlotRecordBlock.concat(blocks)
@@ -228,6 +231,11 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
             sid = (None if merged.search_ids is None
                    else merged.search_ids[base:base + cnt])
             out.ads_offset[i] = build_ads_offset(sid, cnt, batch_size)
+    # pass-feed pack latency: whole-pass + amortized per-batch (the host
+    # cost the pass-resident feed exists to keep out of the train loop)
+    dt = time.perf_counter() - t_pack
+    stat_observe("data.pass_feed.pack_s", dt)
+    stat_observe("data.pass_feed.batch_pack_s", dt / max(1, n_batches))
     return out
 
 
@@ -350,6 +358,7 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
     already sharded (record dim split over the mesh) so the full pass never
     materializes on a single device; the relayout then runs under GSPMD and
     the result is device_put to the final batch-dim shardings."""
+    t_up = time.perf_counter()
     h = host_arrays
     N, B = h.n_batches, h.batch_size
     in_shardings = {}
@@ -395,6 +404,7 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
     if sharding is not None:
         data = {k: jax.device_put(v, sharding[k]) if k in sharding else v
                 for k, v in data.items()}
+    stat_observe("data.pass_feed.upload_s", time.perf_counter() - t_up)
     return PackedPassFeed(data=data, n_batches=N, batch_size=B,
                           num_real=h.num_real,
                           host=h if keep_host else None, uid=h.uid,

@@ -14,6 +14,7 @@ Inside the worker, call ``init_distributed()`` before building topology.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import subprocess
@@ -29,6 +30,11 @@ def init_distributed(coordinator: Optional[str] = None,
     Reads PBOX_* env set by the launcher when args are omitted.  Returns
     this process's rank.  No-op for single-process jobs."""
     import jax
+    from paddlebox_tpu.utils import obs_server
+    # worker-side observability entry: FLAGS_obs_port (assigned base+rank
+    # by the launcher) starts the /metrics exporter; FLAGS_obs_trace the
+    # span tracer — both no-ops when unset
+    obs_server.maybe_start_from_flags()
     num = num_processes if num_processes is not None else \
         int(os.environ.get("PBOX_WORLD_SIZE", "1"))
     if num <= 1:
@@ -44,11 +50,20 @@ def init_distributed(coordinator: Optional[str] = None,
 
 def launch(script: str, script_args: List[str], nproc: int,
            coordinator: str = "127.0.0.1:12355",
-           max_restarts: int = 0, log_dir: str = "") -> int:
+           max_restarts: int = 0, log_dir: str = "",
+           obs_port: int = 0) -> int:
     """Spawn nproc workers; restart failed ones up to max_restarts
-    (≙ launch controllers' replica watch)."""
+    (≙ launch controllers' replica watch).
+
+    obs_port > 0 assigns each worker rank its own exporter port
+    (``FLAGS_obs_port = obs_port + rank``); the launcher then scrapes
+    every worker's /statz periodically and prints ONE merged job-wide
+    snapshot at teardown (the supervisor-side half of the observability
+    layer — obs_server.merge_snapshots)."""
     procs: List[Optional[subprocess.Popen]] = [None] * nproc
     restarts = [0] * nproc
+    obs_last: Dict[int, Dict] = {}      # rank -> last good /statz
+    obs_t = [0.0]
 
     def spawn(rank: int) -> subprocess.Popen:
         env = dict(os.environ)
@@ -57,6 +72,9 @@ def launch(script: str, script_args: List[str], nproc: int,
             "PBOX_WORLD_SIZE": str(nproc),
             "PBOX_COORDINATOR": coordinator,
         })
+        if obs_port:
+            # pboxlint: disable-next=PB203 -- env export to spawned workers
+            env["FLAGS_obs_port"] = str(obs_port + rank)
         stdout = None
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
@@ -64,6 +82,28 @@ def launch(script: str, script_args: List[str], nproc: int,
         return subprocess.Popen([sys.executable, script] + script_args,
                                 env=env, stdout=stdout,
                                 stderr=subprocess.STDOUT if stdout else None)
+
+    def obs_scrape(final: bool = False) -> None:
+        """Best-effort periodic pull of every live worker's /statz; the
+        merged view prints once at job teardown (day end)."""
+        if not obs_port:
+            return
+        now = time.time()
+        if not final and now - obs_t[0] < 5.0:
+            return
+        obs_t[0] = now
+        from paddlebox_tpu.utils import obs_server
+        for r, p in enumerate(procs):
+            if p is not None and p.poll() is None:
+                snap = obs_server.scrape(obs_port + r)
+                if snap:
+                    obs_last[r] = snap
+        if final and obs_last:
+            merged = obs_server.merge_snapshots(list(obs_last.values()))
+            print("[obs] merged job snapshot "
+                  f"({len(obs_last)} workers): "
+                  + json.dumps(merged, sort_keys=True),
+                  file=sys.stderr, flush=True)
 
     for r in range(nproc):
         procs[r] = spawn(r)
@@ -93,12 +133,15 @@ def launch(script: str, script_args: List[str], nproc: int,
                     procs[r] = None
             if alive == 0:
                 return exit_code
+            obs_scrape()
             time.sleep(0.2)
     except KeyboardInterrupt:
         for q in procs:
             if q is not None and q.poll() is None:
                 q.send_signal(signal.SIGTERM)
         return 130
+    finally:
+        obs_scrape(final=True)
 
 
 def launch_elastic(script: str, script_args: List[str], nproc: int,
@@ -109,7 +152,8 @@ def launch_elastic(script: str, script_args: List[str], nproc: int,
                    max_relaunches: int = 3,
                    heartbeat_ttl: float = 6.0,
                    log_dir: str = "",
-                   poll_s: float = 0.2) -> int:
+                   poll_s: float = 0.2,
+                   obs_port: int = 0) -> int:
     """Elastic job orchestration: relaunch into a shrunk/regrown world.
 
     ≙ ElasticManager + launcher cooperating (fleet/elastic/manager.py:131
@@ -164,6 +208,10 @@ def launch_elastic(script: str, script_args: List[str], nproc: int,
             "PBOX_ELASTIC_DIR": elastic_dir,
             "PBOX_ELASTIC_GEN": str(generation),
         })
+        if obs_port:
+            # rank-based, so ports are stable across generations
+            # pboxlint: disable-next=PB203 -- env export to spawned workers
+            env["FLAGS_obs_port"] = str(obs_port + rank)
         stdout = None
         try:
             if log_dir:
@@ -324,6 +372,12 @@ def main():
                     choices=("", "f32", "f16", "i8"),
                     help="wire encoding of float32 PS row payloads "
                          "(FLAGS_ps_wire_dtype; server state stays fp32)")
+    ap.add_argument("--obs_port", type=int, default=0,
+                    help="observability exporter base port: worker rank r "
+                         "serves /metrics + /statz + /tracez on "
+                         "obs_port + r (FLAGS_obs_port); the launcher "
+                         "scrapes all workers and prints one merged "
+                         "snapshot at job end.  0 = off")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
@@ -356,11 +410,13 @@ def main():
                 coordinator_host=host or "127.0.0.1",
                 coordinator_base_port=int(port) if port else 12400,
                 min_workers=args.min_workers,
-                max_relaunches=args.max_relaunches, log_dir=args.log_dir)
+                max_relaunches=args.max_relaunches, log_dir=args.log_dir,
+                obs_port=args.obs_port)
         else:
             rc = launch(args.script, args.script_args,
                         args.nproc_per_node, args.coordinator,
-                        args.max_restarts, args.log_dir)
+                        args.max_restarts, args.log_dir,
+                        obs_port=args.obs_port)
     finally:
         if proxy is not None:
             proxy.shutdown()

@@ -18,12 +18,14 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from paddlebox_tpu.config import EmbeddingTableConfig
 from paddlebox_tpu.ps import feature_value as fv
+from paddlebox_tpu.utils.monitor import stat_observe
 
 
 class _Shard:
@@ -102,6 +104,9 @@ class _Shard:
         (keys must be unique within one call, which pass-level write-back
         guarantees)."""
         with self.lock:
+            # hold-time histogram: a fat p99 here is writer-side lock
+            # pressure stalling concurrent pulls (the preload thread)
+            t0 = time.monotonic()
             rows, found = self.lookup(keys)
             if found.any():
                 idx = rows[found]
@@ -118,6 +123,8 @@ class _Shard:
                     self.soa[f] = np.concatenate(
                         [self.soa[f], soa[f][~found]])
                 self._sorted_view = None
+        stat_observe("ps.host_table.write_lock_hold_s",
+                     time.monotonic() - t0)
 
 
 class ShardedHostTable:
@@ -169,12 +176,15 @@ class ShardedHostTable:
             # under the shard lock: the pipelined preload thread pulls
             # concurrently with main-thread upserts that rebuild keys/soa
             with shard.lock:
+                t0 = time.monotonic()
                 pos, found = shard.lookup(keys[sel])
                 hit = sel[found]
                 if len(hit):
                     src = pos[found]
                     for f, arr in shard.soa.items():
                         out[f][hit] = arr[src]
+            stat_observe("ps.host_table.pull_lock_hold_s",
+                         time.monotonic() - t0)
         return out
 
     def bulk_write(self, keys: np.ndarray, soa: Dict[str, np.ndarray]) -> None:

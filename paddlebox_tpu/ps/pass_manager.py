@@ -28,12 +28,20 @@ from typing import Dict, List, Optional
 import numpy as np
 import jax.numpy as jnp
 
+from paddlebox_tpu import flags
 from paddlebox_tpu.config import EmbeddingTableConfig
 from paddlebox_tpu.parallel.topology import HybridTopology
 from paddlebox_tpu.ps import embedding
 from paddlebox_tpu.ps.host_table import ShardedHostTable
-from paddlebox_tpu.utils.monitor import stat_add
+from paddlebox_tpu.utils import trace
+from paddlebox_tpu.utils.monitor import stat_add, stat_snapshot
 from paddlebox_tpu.utils.timer import TimerRegistry
+
+flags.define_flag(
+    "obs_pass_report", False,
+    "print a PrintSyncTimer-style per-pass wall-time table (pull/train/"
+    "write seconds, wire bytes, inflight hwm, injected faults) at every "
+    "end_pass (≙ PrintSyncTimer box_wrapper.h:795)")
 
 
 class BoxPSEngine:
@@ -85,6 +93,11 @@ class BoxPSEngine:
         assert not self._feeding, "previous feed pass not closed"
         with self._agent_lock:
             self._agent_keys = []
+        # per-pass observability baseline: the end_pass report prints
+        # DELTAS against these (wire bytes, faults, timer seconds of this
+        # pass only).  Coordinator-only, like the lifecycle flag below.
+        self._pass_stats0 = stat_snapshot("ps.")
+        self._pass_timers0 = {n: (s, c) for n, s, c in self.timers.rows()}
         # the pass lifecycle is driven by one coordinator thread;
         # _agent_lock only guards the add_keys sink
         # pboxlint: disable-next=PB102 -- single-coordinator lifecycle flag
@@ -111,7 +124,8 @@ class BoxPSEngine:
         # per pass (with the end-pass delta push) — surface its wall time
         # in the monitor so the pipelined PS wire path's effect shows up
         # beside the ps.wire.* byte counters (ps/service.py)
-        with self.timers("build_pull"):
+        with self.timers("build_pull"), \
+                trace.span("ps.engine.build_pull", keys=len(uniq)):
             t0 = time.monotonic()
             host_rows = self.table.bulk_pull(uniq)
             stat_add("ps.engine.build_pull_s", time.monotonic() - t0)
@@ -200,15 +214,17 @@ class BoxPSEngine:
 
     # -- train pass ----------------------------------------------------------
     def begin_pass(self) -> None:
-        if self._build_thread is not None or self._next is not None:
-            self.wait_feed_pass_done()   # raises if the async build failed
-            assert self._next is not None
-            self.mapper, self.num_keys, host_rows = self._next
-            self.ws = self._upload(host_rows)
-            self._next = None
-            self._refresh_stale_rows()
-        assert self.ws is not None, "end_feed_pass must run before begin_pass"
-        self.pass_id += 1
+        with trace.span("ps.engine.begin_pass", pass_id=self.pass_id + 1):
+            if self._build_thread is not None or self._next is not None:
+                self.wait_feed_pass_done()  # raises if async build failed
+                assert self._next is not None
+                self.mapper, self.num_keys, host_rows = self._next
+                self.ws = self._upload(host_rows)
+                self._next = None
+                self._refresh_stale_rows()
+            assert self.ws is not None, \
+                "end_feed_pass must run before begin_pass"
+            self.pass_id += 1
 
     def _refresh_stale_rows(self) -> None:
         """An async-built working set pulled host rows while the previous
@@ -262,7 +278,9 @@ class BoxPSEngine:
                 "is an int16 grid, not the f32 store) — a frozen pass ends "
                 "by discarding the device copy (engine.ws = None) or "
                 "rebuilding the pass")
-        with self.timers("dump_to_cpu"):
+        with self.timers("dump_to_cpu"), \
+                trace.span("ps.engine.end_pass_write",
+                           pass_id=self.pass_id, keys=self.num_keys):
             soa = embedding.dump_working_set(self.ws, self.num_keys)
             soa["unseen_days"] = np.zeros((self.num_keys,), np.float32)
             if getattr(self, "_pulled_stats", None) is not None:
@@ -287,6 +305,8 @@ class BoxPSEngine:
             self._pulled_stats = None
         self.ws = None
         self._last_written = np.asarray(self.mapper.sorted_keys)
+        if flags.get_flags("obs_pass_report"):
+            print(self.pass_report(), flush=True)
         if need_save_delta and delta_path:
             self.save_delta(delta_path)
 
@@ -324,3 +344,43 @@ class BoxPSEngine:
 
     def print_sync_timers(self) -> str:
         return self.timers.report()
+
+    def pass_report(self) -> str:
+        """PrintSyncTimer-style per-pass wall-time table (≙ PrintSyncTimer
+        box_wrapper.h:795): the phase seconds of THIS pass (deltas since
+        begin_feed_pass), plus the pass's wire bytes, pipeline pressure
+        and injected-fault counts — the at-a-glance answer to "was this
+        pass pull-bound, train-bound or write-bound?".  Printed at every
+        end_pass under ``FLAGS_obs_pass_report``."""
+        stats0 = getattr(self, "_pass_stats0", None) or {}
+        timers0 = getattr(self, "_pass_timers0", None) or {}
+        cur = stat_snapshot("ps.")
+
+        def delta(key: str) -> float:
+            return cur.get(key, 0.0) - stats0.get(key, 0.0)
+
+        lines = [f"---- PrintSyncTimer pass {self.pass_id} "
+                 f"day {self.day_id or '-'} ----",
+                 f"  {'phase':<20} {'seconds':>10} {'count':>7}"]
+        for name, secs, count in self.timers.rows():
+            s0, c0 = timers0.get(name, (0.0, 0))
+            if count - c0 == 0 and secs - s0 < 1e-9:
+                continue            # phase did not run this pass
+            lines.append(f"  {name:<20} {secs - s0:>10.3f} "
+                         f"{count - c0:>7d}")
+        tx = {k[len("ps.wire."):-len(".tx_bytes")]: delta(k)
+              for k in cur if k.startswith("ps.wire.")
+              and k.endswith(".tx_bytes") and delta(k) > 0}
+        if tx:
+            per_verb = " ".join(f"{v}={int(b)}" for v, b in sorted(tx.items()))
+            lines.append(f"  wire tx_bytes: total={int(sum(tx.values()))} "
+                         f"({per_verb})")
+        lines.append(
+            f"  inflight_hwm={int(cur.get('ps.client.inflight_hwm', 0))} "
+            f"pipeline_stall={delta('ps.client.pipeline_stall_s'):.3f}s "
+            f"retries={int(delta('ps.client.retry'))} "
+            f"dedup_hits={int(delta('ps.server.dedup_hit'))}")
+        faults_n = sum(delta(k) for k in cur if k.startswith("ps.fault."))
+        if faults_n:
+            lines.append(f"  injected_faults={int(faults_n)}")
+        return "\n".join(lines)

@@ -69,8 +69,10 @@ import numpy as np
 from paddlebox_tpu import flags
 from paddlebox_tpu.ps import faults, wire
 from paddlebox_tpu.ps.host_table import ShardedHostTable
+from paddlebox_tpu.utils import trace
 from paddlebox_tpu.utils.backoff import Backoff
-from paddlebox_tpu.utils.monitor import stat_add, stat_max
+from paddlebox_tpu.utils.monitor import (stat_add, stat_max, stat_observe,
+                                         stat_snapshot)
 
 DEFAULT_TABLE = "embedding"
 
@@ -94,6 +96,12 @@ flags.define_flag(
     "push_sparse_delta frames: f32 (exact), f16, or i8 (per-chunk-per-"
     "field scales; ~2x/4x fewer wire bytes).  Server table state stays "
     "fp32 — payloads dequantize at decode")
+flags.define_flag(
+    "obs_slow_verb_ms", 0.0,
+    "server-side slow-verb threshold in milliseconds: a dispatch slower "
+    "than this logs a warning and bumps ps.server.slow_verb (0 = off).  "
+    "Latency histograms (ps.server.<verb>.latency_s.*) record "
+    "regardless")
 flags.define_flag(
     "ps_snap_cap", 4,
     "RemoteTableAdapter cap on concurrent delta-mode pull snapshots; "
@@ -361,9 +369,27 @@ class PSServer:
         return t
 
     def _dispatch(self, req: Dict) -> Dict:
-        """Fault hook + exactly-once wrapper around the verb switch."""
+        """Fault hook + exactly-once wrapper around the verb switch.
+        Observes every verb's server-side dispatch latency (dedup replays
+        included — they are dispatches, just fast ones) and flags
+        dispatches past ``FLAGS_obs_slow_verb_ms``."""
         if faults.ACTIVE is not None:
             faults.on_dispatch(req.get("cmd"), self)
+        cmd = req.get("cmd")
+        t0 = time.monotonic()
+        try:
+            return self._dispatch_dedup(req)
+        finally:
+            dt = time.monotonic() - t0
+            stat_observe(f"ps.server.{cmd}.latency_s", dt)
+            slow_ms = float(flags.get_flags("obs_slow_verb_ms"))
+            if slow_ms > 0 and dt * 1000.0 >= slow_ms:
+                stat_add("ps.server.slow_verb")
+                logging.getLogger(__name__).warning(
+                    "slow verb: %s took %.1fms (threshold %gms, rid=%s)",
+                    cmd, dt * 1000.0, slow_ms, req.get(wire.RID_FIELD))
+
+    def _dispatch_dedup(self, req: Dict) -> Dict:
         rid = req.get(wire.RID_FIELD)
         if rid is None:
             return self._exec(req)
@@ -386,6 +412,21 @@ class PSServer:
         return resp
 
     def _exec(self, req: Dict) -> Dict:
+        """Span wrapper around the verb switch: a server dispatch span
+        opens only when the verb actually EXECUTES (a dedup-window
+        replay returns before reaching here — chaos retries never
+        duplicate server spans) and parents to the originating client
+        span via the wire trace context."""
+        tr = trace.ACTIVE
+        if tr is None:
+            return self._exec_verb(req)
+        cmd = req.get("cmd")
+        with tr.span(f"ps.server.{cmd}",
+                     parent=req.get(wire.TRACE_FIELD),
+                     rid=req.get(wire.RID_FIELD)):
+            return self._exec_verb(req)
+
+    def _exec_verb(self, req: Dict) -> Dict:
         cmd = req["cmd"]
         if cmd == "pull_sparse":
             t = self._table(req)
@@ -456,12 +497,16 @@ class PSServer:
                     "tables": {n: t.size() for n, t in self.tables.items()}}
         if cmd == "health":
             # heartbeat: cheap liveness + drain visibility for clients and
-            # the launcher's replica watch
+            # the launcher's replica watch.  The stats sub-dict makes a
+            # remote liveness check double as a metrics pull (verb-latency
+            # percentiles included) even with FLAGS_obs_port off
             with self._inflight_cv:
                 inflight = self._inflight
             return {"ok": True, "draining": self._draining,
                     "inflight": inflight,
-                    "tables": ",".join(sorted(self.tables))}
+                    "tables": ",".join(sorted(self.tables)),
+                    "stats": {k: float(v)
+                              for k, v in stat_snapshot("ps.").items()}}
         if cmd == "barrier":
             world = req["world"]
             with self._barrier_cv:
@@ -652,6 +697,9 @@ class _PipelineRun:
                 stalled += time.monotonic() - t0
         if stalled:
             stat_add("ps.client.pipeline_stall_s", stalled)
+            # per-chunk wait distribution: a fat p99 here means the wire
+            # is persistently ahead of the window (raise FLAGS_ps_window)
+            stat_observe("ps.client.pipeline_wait_s", stalled)
         return job
 
     def complete(self, idx: int, resp: Dict) -> None:
@@ -858,10 +906,33 @@ class PSClient:
         makes the resend of an applied-but-unacknowledged mutation return
         the cached response — exactly-once, so even barrier/allreduce/
         delta verbs retry safely.  Backoff is exponential with jitter
-        under ``deadline`` (default: the client's budget)."""
+        under ``deadline`` (default: the client's budget).
+
+        Observability: one client span per verb (skipped when the caller
+        pre-stamped a trace context — pipelined chunk requests carry
+        their logical verb's span) and a client-side latency histogram
+        per successful round trip, retries included."""
         if dedup and wire.RID_FIELD not in req:
             req = dict(req)
             req[wire.RID_FIELD] = self._next_rid()
+        cmd = req.get("cmd")
+        tr = trace.ACTIVE
+        span = None
+        if tr is not None and wire.TRACE_FIELD not in req:
+            span = tr.start_span(f"ps.client.{cmd}",
+                                 rid=req.get(wire.RID_FIELD))
+            req = dict(req)
+            req[wire.TRACE_FIELD] = span.context()
+        t_call = time.monotonic()
+        try:
+            return self._call_attempts(req, retry, timeout, deadline,
+                                       t_call)
+        finally:
+            if span is not None:
+                tr.finish(span)
+
+    def _call_attempts(self, req: Dict, retry: bool, timeout: float,
+                       deadline: Optional[float], t_call: float) -> Dict:
         rid = req.get(wire.RID_FIELD)
         bo = Backoff(base=self.retry_sleep, cap=self.backoff_cap,
                      deadline=self.deadline if deadline is None
@@ -903,6 +974,9 @@ class PSClient:
             self._checkin(stream)
             if not resp.get("ok"):
                 raise RuntimeError(resp.get("error", "ps error"))
+            cmd = req.get("cmd")
+            stat_observe(f"ps.client.{cmd}.latency_s",
+                         time.monotonic() - t_call)
             return resp
 
     # -- pipelined chunk engine ---------------------------------------------
@@ -987,12 +1061,16 @@ class PSClient:
                                 return
                             if not pending and state["done"]:
                                 return
-                            idx, req = pending[0]
+                            idx, req, t_sent = pending[0]
                         resp = _recv(sock, role="client")
                         rid = req[wire.RID_FIELD]
                         if resp.get(wire.RID_FIELD, rid) != rid:
                             raise ConnectionError(
                                 "stale response (rid mismatch)")
+                        # pipelined chunks never pass through _call — the
+                        # per-rpc client latency lands here instead
+                        stat_observe(f"ps.client.{req['cmd']}.latency_s",
+                                     time.monotonic() - t_sent)
                         with cv:
                             pending.popleft()
                             state["progress"] = True
@@ -1025,7 +1103,7 @@ class PSClient:
                         break
                     idx, req = job
                     with cv:
-                        pending.append((idx, req))
+                        pending.append((idx, req, time.monotonic()))
                         cv.notify_all()
                     try:
                         # encode happens inside _send — on this thread,
@@ -1073,7 +1151,7 @@ class PSClient:
             # stream — then reconnect under the deadline budget
             self._close_stream(stream)
             with cv:
-                leftover = list(pending)
+                leftover = [(i, r) for i, r, _ in pending]
                 pending.clear()
             if leftover:
                 run.requeue(leftover)
@@ -1091,13 +1169,24 @@ class PSClient:
                 return
 
     # -- verbs (table=None → the default table) -----------------------------
+    @staticmethod
+    def _stamp_trace(req: Dict) -> Dict:
+        """Attach the calling span's wire context (no-op when the tracer
+        is off or no span is open): pipelined chunks parent their server
+        spans to the enclosing logical-verb span instead of opening one
+        client span per chunk."""
+        ctx = trace.wire_context()
+        if ctx is not None:
+            req[wire.TRACE_FIELD] = ctx
+        return req
+
     def _pull_req(self, sub_keys: np.ndarray, table: Optional[str],
                   create: bool) -> Dict:
         req = {"cmd": "pull_sparse", "keys": sub_keys, "table": table,
                "create": create, wire.RID_FIELD: self._next_rid()}
         if self.wire_dtype != "f32":
             req["wire_dtype"] = self.wire_dtype
-        return req
+        return self._stamp_trace(req)
 
     def pull_sparse(self, keys: np.ndarray, table: Optional[str] = None,
                     create: bool = False) -> Dict[str, np.ndarray]:
@@ -1108,6 +1197,11 @@ class PSClient:
         one estimate read + one write per call instead of per chunk, and
         deterministic chunking for a given first response."""
         keys = np.asarray(keys)
+        with trace.span("ps.client.pull_sparse.bulk", keys=len(keys)):
+            return self._pull_sparse_chunked(keys, table, create)
+
+    def _pull_sparse_chunked(self, keys: np.ndarray, table: Optional[str],
+                             create: bool) -> Dict[str, np.ndarray]:
         tname = table or DEFAULT_TABLE
         with self._lock:
             learned = self._row_bytes_est.get(tname)
@@ -1139,15 +1233,18 @@ class PSClient:
     def push_sparse(self, keys: np.ndarray, rows: Dict[str, np.ndarray],
                     table: Optional[str] = None):
         keys = np.asarray(keys)
-        per_row = self._rows_bytes(rows)
-        reqs = []
-        for lo, c in self._chunk_counts(len(keys), per_row):
-            chunk = {f: np.asarray(v)[lo:lo + c] for f, v in rows.items()}
-            reqs.append({"cmd": "push_sparse", "keys": keys[lo:lo + c],
-                         "rows": self._quant_rows(chunk, "push_sparse"),
-                         "table": table,
-                         wire.RID_FIELD: self._next_rid()})
-        self._pipeline(reqs)
+        with trace.span("ps.client.push_sparse.bulk", keys=len(keys)):
+            per_row = self._rows_bytes(rows)
+            reqs = []
+            for lo, c in self._chunk_counts(len(keys), per_row):
+                chunk = {f: np.asarray(v)[lo:lo + c]
+                         for f, v in rows.items()}
+                reqs.append(self._stamp_trace(
+                    {"cmd": "push_sparse", "keys": keys[lo:lo + c],
+                     "rows": self._quant_rows(chunk, "push_sparse"),
+                     "table": table,
+                     wire.RID_FIELD: self._next_rid()}))
+            self._pipeline(reqs)
 
     def push_sparse_delta(self, keys: np.ndarray,
                           rows: Dict[str, np.ndarray],
@@ -1165,22 +1262,26 @@ class PSClient:
         keys = np.asarray(keys)
         rows_abs = rows_abs or {}
         group = rid_group or self.new_rid_group()
-        per_row = self._rows_bytes(rows) + self._rows_bytes(rows_abs)
-        reqs = []
-        for i, (lo, c) in enumerate(
-                self._chunk_counts(len(keys), per_row)):
-            delta = {f: np.asarray(v)[lo:lo + c] for f, v in rows.items()}
-            reqs.append({"cmd": "push_sparse_delta",
-                         "keys": keys[lo:lo + c],
-                         "rows": self._quant_rows(delta,
-                                                  "push_sparse_delta"),
-                         # absolute metadata (slot, mf_size, beta powers)
-                         # must survive the wire EXACT — never quantized
-                         "rows_abs": {f: np.asarray(v)[lo:lo + c]
-                                      for f, v in rows_abs.items()},
-                         "table": table,
-                         wire.RID_FIELD: f"{group}.{i}"})
-        self._pipeline(reqs)
+        with trace.span("ps.client.push_sparse_delta.bulk",
+                        keys=len(keys), group=group):
+            per_row = self._rows_bytes(rows) + self._rows_bytes(rows_abs)
+            reqs = []
+            for i, (lo, c) in enumerate(
+                    self._chunk_counts(len(keys), per_row)):
+                delta = {f: np.asarray(v)[lo:lo + c]
+                         for f, v in rows.items()}
+                reqs.append(self._stamp_trace(
+                    {"cmd": "push_sparse_delta",
+                     "keys": keys[lo:lo + c],
+                     "rows": self._quant_rows(delta,
+                                              "push_sparse_delta"),
+                     # absolute metadata (slot, mf_size, beta powers)
+                     # must survive the wire EXACT — never quantized
+                     "rows_abs": {f: np.asarray(v)[lo:lo + c]
+                                  for f, v in rows_abs.items()},
+                     "table": table,
+                     wire.RID_FIELD: f"{group}.{i}"}))
+            self._pipeline(reqs)
 
     def pull_dense(self, name: str) -> Optional[np.ndarray]:
         return self._call({"cmd": "pull_dense", "name": name})["value"]

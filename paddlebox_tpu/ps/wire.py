@@ -58,6 +58,14 @@ _MAX_NDIM = 16
 # it on mutating requests and echoes it on responses
 RID_FIELD = "rid"
 
+# optional Dapper-style trace-context field riding beside the rid: a
+# string ``<trace_id>/<span_id>`` naming the originating client span
+# (utils/trace.py).  The server parents its dispatch span to it, so one
+# trace id follows a verb across the process boundary; retries resend
+# the SAME context, and dedup-window replays never open a second server
+# span — trace topology survives the exactly-once protocol unchanged.
+TRACE_FIELD = "tctx"
+
 # legal FLAGS_ps_wire_dtype values (f32 = exact passthrough, no tag 7)
 WIRE_DTYPES = ("f32", "f16", "i8")
 _F16_MAX = 65504.0

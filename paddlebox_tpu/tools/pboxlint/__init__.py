@@ -8,6 +8,8 @@ interpreter, so this package supplies the equivalent as lint passes over
 
   PB1xx  lock discipline        (tools/pboxlint/locks.py)
   PB2xx  flag hygiene           (tools/pboxlint/flags_hygiene.py)
+         + metric/span name hygiene, PB204
+           (tools/pboxlint/metric_names.py)
   PB3xx  JAX purity             (tools/pboxlint/purity.py)
   PB4xx  threading lifecycle    (tools/pboxlint/lifecycle.py)
   PB5xx  retry/backoff          (tools/pboxlint/retries.py)

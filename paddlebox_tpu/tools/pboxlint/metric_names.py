@@ -1,0 +1,132 @@
+"""PB2xx (cont.) — metric/span name hygiene (the StatRegistry +
+SpanTracer cardinality discipline, utils/monitor.py / utils/trace.py).
+
+  PB204  a metric or span name passed to ``stat_add`` / ``stat_observe``
+         / ``stat_max`` / ``stat_set`` / ``stat_get`` or a span starter
+         (``span`` / ``start_span``) is either
+
+         * built dynamically (f-string / ``+`` concatenation) from a
+           part that is not a KNOWN BOUNDED FIELD — every distinct name
+           becomes a permanent StatRegistry entry, so an unbounded
+           dynamic part (a key, a rid, a path) silently grows the
+           process-wide registry forever, or
+         * a literal that is not a lowercase dotted identifier
+           (``[a-z0-9_.]``) — mixed-case/spaced names fracture the
+           dotted-prefix namespace that ``snapshot(prefix)``, the
+           per-pass report and the Prometheus exporter all key on.
+
+Bounded fields are the closed vocabularies of the wire protocol: a verb
+name, a fault site/kind, a role — recognized syntactically as a name,
+attribute or const-subscript whose TERMINAL component is one of
+``cmd / verb / site / kind / role / phase / stage / table`` (e.g.
+``verb``, ``msg['cmd']``, ``hit.kind``).  Anything else — ``f"k.{key}"``,
+``"k." + rid`` — is flagged.  A deliberately dynamic name suppresses
+with a reason, like every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
+                                               PackageContext, dotted_name)
+
+_NAME_SINKS = {"stat_add", "stat_observe", "stat_max", "stat_set",
+               "stat_get", "span", "start_span"}
+_BOUNDED_FIELDS = {"cmd", "verb", "site", "kind", "role", "phase",
+                   "stage", "table"}
+_LITERAL_OK = re.compile(r"[a-z0-9_.]*\Z")
+
+
+def _terminal_field(node: ast.AST) -> Optional[str]:
+    """The terminal component of a simple value expression: ``verb`` →
+    "verb", ``hit.kind`` → "kind", ``msg['cmd']`` → "cmd"; None for
+    anything more dynamic (calls, arithmetic, nested subscripts...)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if (isinstance(node, ast.Subscript)
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)):
+        return node.slice.value
+    return None
+
+
+def _check_literal(text: str) -> bool:
+    return bool(_LITERAL_OK.match(text))
+
+
+def _binop_leaves(node: ast.AST) -> Optional[List[ast.AST]]:
+    """Flatten a ``+`` concatenation tree into leaves; None when the
+    tree contains a non-Add operator (out of scope)."""
+    if isinstance(node, ast.BinOp):
+        if not isinstance(node.op, ast.Add):
+            return None
+        left = _binop_leaves(node.left)
+        right = _binop_leaves(node.right)
+        if left is None or right is None:
+            return None
+        return left + right
+    return [node]
+
+
+def _findings_for_name(mod: Module, call: ast.Call,
+                       arg: ast.AST) -> List[Finding]:
+    out: List[Finding] = []
+
+    def flag(reason: str) -> None:
+        out.append(Finding(
+            mod.path, call.lineno, "PB204",
+            f"{dotted_name(call.func) or '<call>'}(...) metric/span name "
+            f"{reason} — unbounded name cardinality grows the "
+            f"process-wide StatRegistry forever; bounded dynamic parts "
+            f"are {sorted(_BOUNDED_FIELDS)}, or suppress with a reason"))
+
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        if not _check_literal(arg.value):
+            flag(f"literal {arg.value!r} is not a lowercase dotted "
+                 f"identifier")
+        return out
+    if isinstance(arg, ast.JoinedStr):
+        for part in arg.values:
+            if isinstance(part, ast.Constant):
+                if isinstance(part.value, str) \
+                        and not _check_literal(part.value):
+                    flag(f"literal segment {part.value!r} is not "
+                         f"lowercase dotted")
+            elif isinstance(part, ast.FormattedValue):
+                field = _terminal_field(part.value)
+                if field not in _BOUNDED_FIELDS:
+                    flag("has an f-string part that is not a known "
+                         "bounded field")
+        return out
+    leaves = _binop_leaves(arg)
+    if isinstance(arg, ast.BinOp) and leaves is not None:
+        for leaf in leaves:
+            if isinstance(leaf, ast.Constant):
+                if isinstance(leaf.value, str) \
+                        and not _check_literal(leaf.value):
+                    flag(f"literal segment {leaf.value!r} is not "
+                         f"lowercase dotted")
+            elif _terminal_field(leaf) not in _BOUNDED_FIELDS:
+                flag("is concatenated (+) from a part that is not a "
+                     "known bounded field")
+    # bare names / calls as the whole argument are out of static reach:
+    # the value may be a constant threaded through a helper — the
+    # f-string/+ forms are where unbounded keys actually get minted
+    return out
+
+
+def check(mod: Module, ctx: PackageContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        tail = dotted_name(node.func).rsplit(".", 1)[-1]
+        if tail not in _NAME_SINKS:
+            continue
+        findings.extend(_findings_for_name(mod, node, node.args[0]))
+    return findings

@@ -37,7 +37,9 @@ from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
 from paddlebox_tpu.parallel.topology import HybridTopology
 from paddlebox_tpu.ps import embedding, optimizer as sparse_opt
 from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.utils import trace
 from paddlebox_tpu.utils.channel import Channel, ChannelClosed
+from paddlebox_tpu.utils.monitor import stat_observe
 from paddlebox_tpu.utils.timer import TimerRegistry
 from paddlebox_tpu import flags
 
@@ -825,10 +827,16 @@ class SparseTrainer:
                 f"{self.engine.pass_id}.txt", "w")
         try:
             for i in range(feed.n_batches):
+                t_step = time.perf_counter()
                 with self.timers("step"):
                     out = self._packed_step_fn(ws, params, opt_state,
                                                auc_state, np.int32(i),
                                                feed.data, plans)
+                # per-batch dispatch latency distribution (the loss
+                # readback below is the sync point, so this is dispatch
+                # cost, not device step time)
+                stat_observe("trainer.step_dispatch_s",
+                             time.perf_counter() - t_step)
                 if async_dense:
                     (ws, params, opt_state, auc_state, loss, preds,
                      d_params) = out
@@ -943,8 +951,23 @@ class SparseTrainer:
         A PackedPassFeed (build_pass_feed) routes to the device-resident
         loop instead — zero per-batch host work.
         """
-        if isinstance(dataset, PackedPassFeed):
-            return self._train_packed(dataset, progress)
+        t0 = time.perf_counter()
+        with trace.span("trainer.train_pass", pass_id=self.engine.pass_id):
+            if isinstance(dataset, PackedPassFeed):
+                stats = self._train_packed(dataset, progress)
+            else:
+                stats = self._train_stream(dataset, prefetch, pack_threads,
+                                           progress)
+        dt = time.perf_counter() - t0
+        # "train" seconds land in the ENGINE's registry so the per-pass
+        # PrintSyncTimer report shows pull/train/write side by side
+        self.engine.timers.add("train", dt)
+        stat_observe("trainer.train_pass_s", dt)
+        return stats
+
+    def _train_stream(self, dataset: SlotDataset, prefetch: int,
+                      pack_threads: int, progress) -> Dict[str, float]:
+        """Per-batch host-pack path of train_pass (streaming datasets)."""
         self._require_pv_for_rank(dataset)
         if self._step_fn is None:
             self._build_step()

@@ -1,0 +1,189 @@
+"""In-process observability exporter — one stdlib ``http.server`` thread
+serving the process's StatRegistry and span ring (Prometheus-style pull
+exposition, PAPERS.md):
+
+  ``/metrics``  Prometheus text exposition: counters/gauges as gauges,
+                histograms as summaries (quantile/sum/count lines).
+  ``/statz``    the full flat JSON snapshot (counters + histogram
+                percentile keys) — the machine-merge surface the
+                launch.py supervisor scrapes into one job-wide view.
+  ``/tracez``   newest-N finished spans from the host tracer
+                (utils/trace.py), JSON.
+
+Off by default: ``FLAGS_obs_port`` = 0 starts nothing and no
+instrumentation site pays more than an is-None/flag check.  launch.py
+assigns ``base_port + rank`` to each worker; ``init_distributed``
+starts the server from the flag, and starting the exporter also enables
+the span tracer (``/tracez`` without a tracer would always be empty).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from paddlebox_tpu import flags
+from paddlebox_tpu.utils import trace
+from paddlebox_tpu.utils.monitor import StatRegistry
+
+flags.define_flag(
+    "obs_port", 0,
+    "serve /metrics (Prometheus text), /statz (JSON snapshot) and "
+    "/tracez (recent spans) on 127.0.0.1:<port>; 0 = off.  launch.py "
+    "--obs_port assigns base+rank per worker; starting the exporter "
+    "also enables the span tracer")
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    return "pbox_" + _PROM_BAD.sub("_", name)
+
+
+def render_prometheus() -> str:
+    """Prometheus text exposition (version 0.0.4) of the registry:
+    plain stats as gauges, histograms as summaries."""
+    reg = StatRegistry.instance()
+    lines: List[str] = []
+    for name, val in sorted(reg.counter_snapshot().items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {val!r}")
+    for name, summ in sorted(reg.hist_snapshot().items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} summary")
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            lines.append(f'{pn}{{quantile="{q}"}} {summ[key]!r}')
+        lines.append(f"{pn}_sum {summ['sum']!r}")
+        lines.append(f"{pn}_count {int(summ['count'])}")
+    return "\n".join(lines) + "\n"
+
+
+def render_statz() -> str:
+    return json.dumps(StatRegistry.instance().snapshot(), sort_keys=True)
+
+
+def render_tracez(limit: int = 256) -> str:
+    spans = trace.ACTIVE.spans(limit) if trace.ACTIVE is not None else []
+    return json.dumps({"enabled": trace.ACTIVE is not None,
+                       "spans": spans})
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):        # no stderr spam per scrape
+        pass
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body = render_prometheus()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/statz":
+                body, ctype = render_statz(), "application/json"
+            elif path == "/tracez":
+                body, ctype = render_tracez(), "application/json"
+            else:
+                self.send_error(404, "unknown path (want /metrics, "
+                                     "/statz, /tracez)")
+                return
+        except Exception as e:  # noqa: BLE001 — a scrape must never kill
+            self.send_error(500, repr(e))
+            return
+        raw = body.encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+
+class ObsServer:
+    """One daemon HTTP thread per process; ``port=0`` binds an ephemeral
+    port (tests), ``addr`` reports the bound (host, port)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._srv = ThreadingHTTPServer((host, port), _Handler)
+        self._srv.daemon_threads = True
+        self.addr: Tuple[str, int] = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+_SERVER: Optional[ObsServer] = None
+_SERVER_LOCK = threading.Lock()
+
+
+def start(port: int = 0, host: str = "127.0.0.1") -> ObsServer:
+    """Start (or return) the process-wide exporter.  Also enables the
+    span tracer so /tracez has a source."""
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is None:
+            trace.enable()
+            _SERVER = ObsServer(port=port, host=host)
+        return _SERVER
+
+
+def stop() -> None:
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            _SERVER.shutdown()
+            _SERVER = None
+
+
+def maybe_start_from_flags() -> Optional[ObsServer]:
+    """Worker entry hook: start the exporter iff ``FLAGS_obs_port`` is
+    set (launch.py exports base+rank per worker); always honors
+    ``FLAGS_obs_trace`` for the tracer alone."""
+    trace.maybe_enable_from_flags()
+    port = int(flags.get_flags("obs_port"))
+    if port <= 0:
+        return None
+    return start(port=port)
+
+
+# -- supervisor-side scrape/merge -------------------------------------------
+def scrape(port: int, path: str = "/statz", host: str = "127.0.0.1",
+           timeout: float = 2.0) -> Optional[Dict[str, float]]:
+    """GET one worker's snapshot; None on any failure (a dead or
+    not-yet-listening worker must not fail the supervisor)."""
+    url = f"http://{host}:{port}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except Exception:  # noqa: BLE001 — scrape is best-effort by contract
+        return None
+
+
+_MERGE_MAX_SUFFIXES = (".max", ".p50", ".p95", ".p99", "hwm")
+
+
+def merge_snapshots(snaps: List[Dict[str, float]]) -> Dict[str, float]:
+    """Fold per-worker /statz snapshots into one job-wide view: counters
+    and sums ADD across workers; high-water marks and percentile keys
+    take the worst (max) worker — a job is as slow as its slowest
+    shard."""
+    out: Dict[str, float] = {}
+    for snap in snaps:
+        if not snap:
+            continue
+        for k, v in snap.items():
+            if not isinstance(v, (int, float)):
+                continue
+            if k.endswith(_MERGE_MAX_SUFFIXES):
+                if v > out.get(k, float("-inf")):
+                    out[k] = v
+            else:
+                out[k] = out.get(k, 0.0) + v
+    return out

@@ -17,22 +17,33 @@ from typing import Optional
 
 import jax
 
+from paddlebox_tpu.utils import trace
 from paddlebox_tpu.utils.timer import TimerRegistry
 
 
 class RecordEvent:
-    """≙ platform::RecordEvent span; shows up in the device trace."""
+    """≙ platform::RecordEvent span; shows up in the device trace — and,
+    when the host tracer is enabled (utils/trace.py), as a host span too,
+    so the merged Chrome trace carries both layers."""
 
     def __init__(self, name: str):
         self.name = name
         self._ctx = None
+        self._span = None
+        self._tracer = None
 
     def __enter__(self):
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
+        self._tracer = trace.ACTIVE
+        if self._tracer is not None:
+            self._span = self._tracer.start_span(self.name)
         return self
 
     def __exit__(self, *exc):
+        if self._span is not None:
+            self._tracer.finish(self._span)
+            self._span = None
         self._ctx.__exit__(*exc)
 
 
@@ -57,6 +68,11 @@ class Profiler:
         if self._running:
             jax.profiler.stop_trace()
             self._running = False
+            if trace.ACTIVE is not None:
+                # merge the host span ring into the same trace collection:
+                # host_spans.trace.json lands beside the XLA dump, so one
+                # Perfetto load shows device ops AND PS verb spans
+                trace.ACTIVE.export_chrome_trace(self.log_dir)
 
     def step(self) -> None:
         """Call once per train step; starts/stops per the schedule."""

@@ -74,6 +74,14 @@ class TimerRegistry:
                      for k, t in sorted(self._timers.items())]
         return " ".join(parts)
 
+    def rows(self):
+        """[(name, elapsed_sec, count)] sorted by name — the structured
+        face of report() (the per-pass PrintSyncTimer table renders from
+        this, ps/pass_manager.py)."""
+        with self._lock:
+            return [(k, t.elapsed_sec(), t.count())
+                    for k, t in sorted(self._timers.items())]
+
     def reset(self) -> None:
         with self._lock:
             for t in self._timers.values():

@@ -1,0 +1,450 @@
+"""Observability layer: histogram percentiles vs a numpy reference,
+snapshot prefix-boundary semantics, the /metrics + /statz + /tracez
+exporter round trip, wire-propagated trace context surviving the
+pipelined multi-stream path and chaos retries WITHOUT duplicate server
+spans, the per-pass PrintSyncTimer report, the health-verb stats
+sub-dict, and the PB204 metric-name lint rule."""
+
+import json
+import textwrap
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import flags
+from paddlebox_tpu.config import EmbeddingTableConfig
+from paddlebox_tpu.ps import faults
+from paddlebox_tpu.ps.host_table import ShardedHostTable
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.ps.service import PSClient, PSServer, RemoteTableAdapter
+from paddlebox_tpu.utils import obs_server, trace
+from paddlebox_tpu.utils.monitor import (Histogram, StatRegistry, stat_add,
+                                         stat_get, stat_observe, stat_set,
+                                         stat_snapshot)
+
+CFG = dict(embedding_dim=4, shard_num=4)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    StatRegistry.instance().reset()
+    trace.disable()
+    yield
+    faults.uninstall()
+    trace.disable()
+    flags.set_flags({"ps_fault_injection": False, "obs_pass_report": False})
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as r:
+        return r.read().decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# histograms + registry semantics
+# ---------------------------------------------------------------------------
+def test_histogram_percentiles_match_numpy_reference():
+    rng = np.random.default_rng(42)
+    # latency-shaped data spanning several orders of magnitude
+    vals = rng.lognormal(mean=-6.0, sigma=1.6, size=50_000)
+    h = Histogram()
+    for v in vals:
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == len(vals)
+    assert s["sum"] == pytest.approx(vals.sum())
+    assert s["max"] == vals.max()                       # exact, not bucketed
+    for q in (50, 95, 99):
+        ref = np.percentile(vals, q)
+        est = h.percentile(q)
+        # quarter-octave log buckets: ≤ ~9% bucket-width error, leave
+        # headroom for within-bucket distribution skew
+        assert abs(est - ref) / ref < 0.20, (q, est, ref)
+
+
+def test_histogram_extremes_and_empty():
+    h = Histogram()
+    assert h.percentile(50) == 0.0
+    h.observe(0.0)                      # underflow bucket
+    h.observe(1e12)                     # overflow bucket
+    assert h.summary()["max"] == 1e12
+    assert h.percentile(99) == 1e12
+    assert h.count == 2
+
+
+def test_stat_observe_snapshot_keys():
+    for v in (0.001, 0.002, 0.004):
+        stat_observe("t.lat_s", v)
+    s = stat_snapshot("t.lat_s")
+    assert s["t.lat_s.count"] == 3.0
+    assert s["t.lat_s.max"] == 0.004
+    assert s["t.lat_s.p50"] > 0
+    # histogram keys participate in prefix scrapes like counters
+    assert "t.lat_s.p99" in stat_snapshot("t.")
+
+
+def test_snapshot_prefix_matches_dotted_segments_only():
+    stat_add("ps.s.y", 2.0)
+    stat_add("ps.streams.x", 1.0)
+    stat_add("ps.s", 7.0)
+    assert set(stat_snapshot("ps.s")) == {"ps.s", "ps.s.y"}
+    assert set(stat_snapshot("ps.streams")) == {"ps.streams.x"}
+    assert set(stat_snapshot("ps.")) == {"ps.s", "ps.s.y", "ps.streams.x"}
+    assert set(stat_snapshot("")) >= {"ps.s", "ps.s.y", "ps.streams.x"}
+
+
+def test_stat_set_overwrites():
+    stat_add("g.v", 5.0)
+    stat_set("g.v", 2.0)
+    assert stat_get("g.v") == 2.0
+    stat_set("g.fresh", 1.5)
+    assert stat_get("g.fresh") == 1.5
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+def test_tracer_nesting_ring_and_chrome_export(tmp_path):
+    tr = trace.enable(ring=8)
+    with trace.span("a.parent") as sp:
+        parent_ctx = sp.context()
+        with trace.span("a.child"):
+            pass
+    spans = tr.spans()
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["a.child"]["trace_id"] == by_name["a.parent"]["trace_id"]
+    assert by_name["a.child"]["parent_id"] == by_name["a.parent"]["span_id"]
+    # explicit parent (the wire form) adopts trace id across "processes"
+    with trace.span("b.remote", parent=parent_ctx):
+        pass
+    remote = tr.spans()[0]
+    assert remote["trace_id"] == by_name["a.parent"]["trace_id"]
+    # ring retention is bounded
+    for i in range(50):
+        with trace.span("c.spam"):
+            pass
+    assert len(tr.spans()) == 8
+    out = tr.export_chrome_trace(str(tmp_path))
+    events = json.load(open(out))["traceEvents"]
+    assert len(events) == 8 and all(e["ph"] == "X" for e in events)
+
+
+def test_tracer_disabled_is_noop():
+    assert trace.ACTIVE is None
+    assert trace.wire_context() is None
+    with trace.span("x.y") as s:
+        assert s is None
+
+
+# ---------------------------------------------------------------------------
+# exporter round trip
+# ---------------------------------------------------------------------------
+def test_metrics_statz_tracez_roundtrip():
+    stat_add("rt.counter", 3.0)
+    for v in (0.01, 0.02, 0.03, 0.04):
+        stat_observe("rt.lat_s", v)
+    tr = trace.enable()
+    with trace.span("rt.span"):
+        pass
+    srv = obs_server.ObsServer(port=0)
+    try:
+        port = srv.addr[1]
+        metrics = _get(port, "/metrics")
+        assert "# TYPE pbox_rt_counter gauge" in metrics
+        assert "pbox_rt_counter 3.0" in metrics
+        assert "# TYPE pbox_rt_lat_s summary" in metrics
+        assert 'pbox_rt_lat_s{quantile="0.99"}' in metrics
+        assert "pbox_rt_lat_s_count 4" in metrics
+        statz = json.loads(_get(port, "/statz"))
+        assert statz["rt.counter"] == 3.0
+        assert statz["rt.lat_s.count"] == 4.0
+        assert statz["rt.lat_s.max"] == 0.04
+        tracez = json.loads(_get(port, "/tracez"))
+        assert tracez["enabled"]
+        assert any(s["name"] == "rt.span" for s in tracez["spans"])
+        # unknown path → 404, server survives
+        with pytest.raises(urllib.error.HTTPError):
+            _get(port, "/nope")
+        assert json.loads(_get(port, "/statz"))["rt.counter"] == 3.0
+    finally:
+        srv.shutdown()
+        assert tr is trace.ACTIVE or trace.ACTIVE is None
+
+
+def test_merge_snapshots_sums_counters_maxes_quantiles():
+    a = {"ps.client.retry": 2.0, "ps.x.latency_s.p99": 0.5,
+         "ps.client.inflight_hwm": 3.0}
+    b = {"ps.client.retry": 1.0, "ps.x.latency_s.p99": 0.9,
+         "ps.client.inflight_hwm": 8.0}
+    m = obs_server.merge_snapshots([a, b])
+    assert m["ps.client.retry"] == 3.0              # summed
+    assert m["ps.x.latency_s.p99"] == 0.9           # worst worker
+    assert m["ps.client.inflight_hwm"] == 8.0       # hwm
+
+
+# ---------------------------------------------------------------------------
+# wire-propagated trace context (composes with ps/faults.py plans)
+# ---------------------------------------------------------------------------
+def test_trace_context_survives_pipeline_and_chaos_without_dup_spans():
+    """A pipelined multi-chunk delta push under an ack-drop fault: the
+    retry resolves through the dedup window, every server span carries
+    the client's trace_id, and NO rid gets a second server span."""
+    tr = trace.enable()
+    flags.set_flags({"ps_fault_injection": True})
+    table = ShardedHostTable(EmbeddingTableConfig(**CFG), seed=0)
+    srv = PSServer(table)
+    try:
+        client = PSClient(srv.addr, retries=None, retry_sleep=0.01,
+                          backoff_cap=0.1, deadline=30,
+                          max_frame=1 << 13, streams=4, window=8)
+        keys = np.unique(np.random.default_rng(0)
+                         .integers(1, 5000, 3000).astype(np.uint64))
+        rows = client.pull_sparse(keys, create=True)
+        d = {f: np.zeros_like(v) for f, v in rows.items()}
+        d["show"] = np.ones(len(keys), np.float32)
+        # first server send dropped: applied-but-unacked → the resend
+        # MUST dedup (no re-execution, hence no second span)
+        faults.install(faults.FaultPlan(seed=7)
+                       .drop("send", role="server", at=(1,)))
+        client.push_sparse_delta(keys, d)
+        faults.uninstall()
+    finally:
+        faults.uninstall()
+        srv.shutdown()
+
+    assert stat_get("ps.server.dedup_hit") >= 1      # the retry deduped
+    assert stat_get("ps.client.inflight_hwm") > 1    # really pipelined
+    spans = tr.spans()
+    bulk = [s for s in spans
+            if s["name"] == "ps.client.push_sparse_delta.bulk"]
+    assert len(bulk) == 1
+    server = [s for s in spans
+              if s["name"] == "ps.server.push_sparse_delta"]
+    assert len(server) > 1                           # multi-chunk
+    rids = [s["attrs"]["rid"] for s in server]
+    assert len(rids) == len(set(rids)), "duplicate server span for a rid"
+    assert all(s["trace_id"] == bulk[0]["trace_id"] for s in server)
+    assert all(s["parent_id"] == bulk[0]["span_id"] for s in server)
+    # client + server latency histograms recorded on both sides
+    snap = stat_snapshot("ps.")
+    assert snap["ps.client.push_sparse_delta.latency_s.count"] > 0
+    assert snap["ps.server.push_sparse_delta.latency_s.p50"] > 0
+
+
+def test_single_rpc_verbs_trace_and_observe():
+    tr = trace.enable()
+    table = ShardedHostTable(EmbeddingTableConfig(**CFG), seed=0)
+    srv = PSServer(table)
+    try:
+        client = PSClient(srv.addr)
+        client.barrier(1, timeout=10)
+        h = client.health()
+        assert "stats" in h
+    finally:
+        srv.shutdown()
+    spans = tr.spans()
+    cli = [s for s in spans if s["name"] == "ps.client.barrier"]
+    sv = [s for s in spans if s["name"] == "ps.server.barrier"]
+    assert len(cli) == 1 and len(sv) == 1
+    assert sv[0]["trace_id"] == cli[0]["trace_id"]
+    assert sv[0]["parent_id"] == cli[0]["span_id"]
+    assert stat_get("ps.client.barrier.latency_s.count") == 0.0  # counter ns
+    assert stat_snapshot("ps.client.barrier.latency_s")[
+        "ps.client.barrier.latency_s.count"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# health verb: liveness doubles as a metrics pull
+# ---------------------------------------------------------------------------
+def test_health_carries_stats_subdict():
+    table = ShardedHostTable(EmbeddingTableConfig(**CFG), seed=0)
+    srv = PSServer(table)
+    try:
+        client = PSClient(srv.addr)
+        keys = np.arange(1, 50, dtype=np.uint64)
+        client.pull_sparse(keys)
+        h = client.health()
+        stats = h["stats"]
+        assert isinstance(stats, dict)
+        # server-side latency histogram of the pull we just did, pulled
+        # REMOTELY with FLAGS_obs_port off
+        assert stats["ps.server.pull_sparse.latency_s.count"] >= 1.0
+        assert all(isinstance(v, float) for v in stats.values())
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# per-pass PrintSyncTimer report
+# ---------------------------------------------------------------------------
+def _drive_one_pass(engine, day, p):
+    rng = np.random.default_rng(1000 * day + p)
+    keys = np.unique(rng.integers(1, 400, size=120).astype(np.uint64))
+    engine.begin_feed_pass()
+    engine.add_keys(keys)
+    engine.end_feed_pass()
+    engine.begin_pass()
+    engine.ws["show"] = engine.ws["show"] + 1.0
+    engine.end_pass()
+
+
+def test_pass_report_prints_table(capsys):
+    table = ShardedHostTable(EmbeddingTableConfig(**CFG), seed=0)
+    srv = PSServer(table)
+    try:
+        client = PSClient(srv.addr)
+        engine = BoxPSEngine(EmbeddingTableConfig(**CFG))
+        engine.table = RemoteTableAdapter(client, delta_mode=True)
+        engine.set_date("20260801")
+        flags.set_flags({"obs_pass_report": True})
+        _drive_one_pass(engine, 0, 0)
+        out = capsys.readouterr().out
+        assert "PrintSyncTimer pass 1 day 20260801" in out
+        assert "build_pull" in out and "dump_to_cpu" in out
+        assert "wire tx_bytes:" in out and "pull_sparse=" in out
+        assert "inflight_hwm=" in out
+        # second pass reports ITS OWN deltas, not cumulative seconds
+        _drive_one_pass(engine, 0, 1)
+        out2 = capsys.readouterr().out
+        assert "PrintSyncTimer pass 2" in out2
+        counts = [ln for ln in out2.splitlines() if "build_pull" in ln]
+        assert counts and counts[0].split()[-1] == "1"   # 1 this pass
+    finally:
+        flags.set_flags({"obs_pass_report": False})
+        srv.shutdown()
+
+
+def test_pass_report_off_by_default(capsys):
+    engine = BoxPSEngine(EmbeddingTableConfig(**CFG))
+    _drive_one_pass(engine, 0, 0)
+    assert "PrintSyncTimer" not in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# the acceptance soak: chaos day with the exporter live
+# ---------------------------------------------------------------------------
+def _chaos_day_with_exporter(days, passes):
+    trace.enable()
+    flags.set_flags({"ps_fault_injection": True})
+    srv_obs = obs_server.ObsServer(port=0)
+    table = ShardedHostTable(EmbeddingTableConfig(**CFG), seed=0)
+    srv = PSServer(table)
+    try:
+        client = PSClient(srv.addr, retries=None, retry_sleep=0.01,
+                          backoff_cap=0.1, deadline=30,
+                          max_frame=1 << 13, streams=4, window=8)
+        # preamble (the test_ps_faults/test_chaos_soak pattern): one pull
+        # (server send 0), then a delta push whose ack (server send 1) is
+        # dropped — applied-but-unacked, so the retry MUST dedup
+        pre = np.array([999_001, 999_002], np.uint64)
+        rows = client.pull_sparse(pre, create=True)
+        d = {f: np.zeros_like(v) for f, v in rows.items()}
+        faults.install(faults.FaultPlan(seed=11)
+                       .drop("send", role="server", at=(1,))
+                       .drop("send", role="client", at=(5,))
+                       .delay("send", 0.001, role="client", prob=0.05))
+        client.pull_sparse(pre)
+        client.push_sparse_delta(pre, d)
+        engine = BoxPSEngine(EmbeddingTableConfig(**CFG))
+        engine.table = RemoteTableAdapter(client, delta_mode=True)
+        for day in range(days):
+            engine.set_date(f"2026080{day + 1}")
+            for p in range(passes):
+                _drive_one_pass(engine, day, p)
+        faults.uninstall()
+        port = srv_obs.addr[1]
+        metrics = _get(port, "/metrics")
+        statz = json.loads(_get(port, "/statz"))
+        tracez = json.loads(_get(port, "/tracez"))
+        return metrics, statz, tracez
+    finally:
+        faults.uninstall()
+        srv.shutdown()
+        srv_obs.shutdown()
+
+
+def _assert_soak_observability(metrics, statz, tracez):
+    # non-zero verb-latency histograms served over /metrics
+    assert 'pbox_ps_server_pull_sparse_latency_s{quantile="0.99"}' in metrics
+    assert statz["ps.server.pull_sparse.latency_s.count"] > 0
+    assert statz["ps.client.push_sparse_delta.latency_s.count"] > 0
+    assert statz["ps.server.dedup_hit"] >= 1
+    # /tracez server dispatch spans carry the originating client trace_id
+    spans = tracez["spans"]
+    server = [s for s in spans if s["name"].startswith("ps.server.")]
+    client_b = [s for s in spans if s["name"].endswith(".bulk")]
+    assert server and client_b
+    client_traces = {s["trace_id"] for s in client_b}
+    linked = [s for s in server if s["trace_id"] in client_traces]
+    assert linked, "no server span carries a client trace id"
+    # dedup-protected verbs must never span twice for one rid (an
+    # idempotent pull retry legitimately RE-EXECUTES and re-spans — only
+    # the exactly-once verbs promise one execution, hence one span)
+    rid_names = {}
+    for s in server:
+        if s["name"] == "ps.server.push_sparse_delta":
+            key = s["attrs"].get("rid")
+            rid_names[key] = rid_names.get(key, 0) + 1
+    dup = {k: n for k, n in rid_names.items() if n > 1 and k is not None}
+    assert not dup, f"duplicate server spans under chaos retry: {dup}"
+
+
+def test_chaos_day_with_exporter_fast():
+    _assert_soak_observability(*_chaos_day_with_exporter(1, 2))
+
+
+@pytest.mark.slow
+def test_chaos_soak_with_exporter_two_days():
+    """Acceptance: a 2-day x 3-pass chaos soak with the exporter live
+    serves non-zero verb-latency histograms on /metrics and /tracez
+    spans whose server dispatch spans carry the client's trace_id."""
+    _assert_soak_observability(*_chaos_day_with_exporter(2, 3))
+
+
+# ---------------------------------------------------------------------------
+# PB204 lint rule
+# ---------------------------------------------------------------------------
+def test_pb204_flags_unbounded_dynamic_names():
+    from paddlebox_tpu.tools.pboxlint import lint_source
+
+    def codes(src):
+        return [f.code for f in lint_source(textwrap.dedent(src))]
+
+    bad = codes("""
+        from paddlebox_tpu.utils.monitor import stat_add
+        def f(key):
+            stat_add(f"ps.keys.{key}", 1.0)
+    """)
+    assert bad == ["PB204"]
+    assert codes("""
+        from paddlebox_tpu.utils.monitor import stat_add
+        def f(rid):
+            stat_add("ps.rid." + rid)
+    """) == ["PB204"]
+    assert codes("""
+        from paddlebox_tpu.utils.monitor import stat_add
+        stat_add("ps.Server.Latency", 1.0)
+    """) == ["PB204"]
+    # bounded fields pass: a verb/cmd's value set is the wire protocol's
+    assert codes("""
+        from paddlebox_tpu.utils.monitor import stat_add, stat_observe
+        def f(verb, msg, hit):
+            stat_add(f"ps.wire.{verb}.tx_bytes", 1.0)
+            stat_observe(f"ps.server.{msg['cmd']}.latency_s", 0.1)
+            stat_add(f"ps.fault.{hit.kind}")
+    """) == []
+    # span starters are covered too
+    assert codes("""
+        import paddlebox_tpu.utils.trace as trace
+        def f(key):
+            with trace.span(f"pass.{key}"):
+                pass
+    """) == ["PB204"]
+    # suppression with a reason works like every other rule
+    assert codes("""
+        from paddlebox_tpu.utils.monitor import stat_add
+        def f(key):
+            stat_add(f"ps.keys.{key}")  # pboxlint: disable=PB204 -- test
+    """) == []
