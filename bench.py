@@ -452,10 +452,15 @@ def run() -> None:
     PACK_THREADS = int(os.environ.get(
         "BENCH_PACK_THREADS", min(8, os.cpu_count() or 1)))
 
-    # backend-init owns the rest of the budget: there IS no later phase
-    # until a backend exists, and the supervisor (not this watchdog)
-    # handles hung-init kills + respawns
-    set_phase("backend-init", TOTAL_BUDGET)
+    # backend-init gets its OWN short budget (just under the supervisor's
+    # attempt window, so the child watchdog fires first and reports
+    # last_phase="backend-init" cleanly instead of dying to an outside
+    # SIGKILL with no output).  BENCH_r05 burned all 1466s on 10 wedged
+    # attempts precisely because init owned the whole budget; now a
+    # wedged init ends the attempt in ~2min and the supervisor's CPU
+    # fallback gets its turn while real budget remains
+    attempt_s = float(os.environ.get("BENCH_BACKEND_ATTEMPT_S", 150))
+    set_phase("backend-init", max(attempt_s - 10, 20))
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         # local validation: the image's sitecustomize pins the 'axon' TPU
         # platform even when JAX_PLATFORMS=cpu; override via jax.config
@@ -602,6 +607,10 @@ def supervise() -> None:
     best = None
     attempts = 0
     last_err = ""
+    attempt_log = []     # per-attempt {platform, last_phase, error} —
+    # recorded into the final BENCH JSON so a failed round says exactly
+    # which phase each attempt died in and on which platform (BENCH_r05's
+    # ten wedged attempts were invisible in the 0.0 result line)
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
     fast_failures = 0        # consecutive child exits within seconds —
     # a systematic error (bad import, broken env), not a tunnel wedge;
@@ -646,10 +655,14 @@ def supervise() -> None:
                 and not backend_up.is_set():
             time.sleep(1)
 
+        platform = "cpu" if force_cpu else "default"
         if not backend_up.is_set() and proc.poll() is None:
             trace(f"supervisor: attempt {attempts} backend wedged "
                   f"after {attempt_window:.0f}s — killing")
             last_err = "backend-init wedged (jax.devices() hang)"
+            attempt_log.append({"attempt": attempts, "platform": platform,
+                                "last_phase": "backend-init",
+                                "error": last_err})
             _kill_child(proc)
             if not force_cpu:
                 # one wedged accelerator attempt is enough evidence: fall
@@ -678,9 +691,25 @@ def supervise() -> None:
         for ln in out_lines:
             attempt_best = _better(attempt_best, _parse_result_line(ln))
         best = _better(best, attempt_best)
+        attempt_log.append({
+            "attempt": attempts, "platform": platform,
+            "last_phase": (attempt_best or {}).get("last_phase")
+            or ("done" if attempt_best is not None
+                and _rank(attempt_best)[0] == 2
+                else (attempt_best or {}).get("stage", "no-output")),
+            "error": (attempt_best or {}).get("error")
+            or (f"rc={proc.returncode}" if proc.returncode else None)})
         if attempt_best is not None and _rank(attempt_best)[0] == 2 \
                 and float(attempt_best.get("value") or 0) > 0:
             break                     # clean TERMINAL result — done
+        if not force_cpu \
+                and (attempt_best or {}).get("last_phase") == "backend-init":
+            # the CHILD's own backend-init watchdog fired (its budget is
+            # shorter than the supervisor window) — same wedge evidence
+            # as a supervisor kill, same response: go CPU
+            force_cpu = True
+            trace("supervisor: falling back to JAX_PLATFORMS=cpu for "
+                  "subsequent attempts")
         if attempt_best is not None and attempt_best.get("error"):
             last_err = str(attempt_best["error"])
         elif not killed and proc.returncode:
@@ -716,6 +745,7 @@ def supervise() -> None:
         # the supervisor's failure context
         best["error"] = last_err or "no clean terminal result"
     best["supervisor_attempts"] = attempts
+    best["attempt_log"] = attempt_log
     if force_cpu and os.environ.get("BENCH_FORCE_CPU") != "1":
         best["platform_fallback"] = "cpu"   # wedge-triggered, not requested
     best["elapsed_s"] = round(time.time() - T0, 1)

@@ -156,7 +156,8 @@ class SlotDataset:
                     blocks.append(block)
 
         with concurrent.futures.ThreadPoolExecutor(
-                max_workers=max(1, self.read_threads)) as pool:
+                max_workers=max(1, self.read_threads),
+                thread_name_prefix="pbox-read") as pool:
             list(pool.map(read_one, files))
         return blocks
 
@@ -168,7 +169,8 @@ class SlotDataset:
     def preload_into_memory(self) -> None:
         """Overlap next-pass read with current training
         (≙ PreLoadIntoMemory box_wrapper.h:1141)."""
-        ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        ex = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pbox-preload")
         self._preload_future = ex.submit(self._read_all)
         ex.shutdown(wait=False)
 

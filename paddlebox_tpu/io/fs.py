@@ -44,6 +44,12 @@ class FileSystem:
     def remove(self, path: str) -> None:
         raise NotImplementedError
 
+    def rename(self, src: str, dst: str) -> None:
+        """Atomic-where-possible move (the write-to-tmp-then-rename commit
+        step of host_table.save).  Schemes without a move verb raise
+        NotImplementedError and callers fall back to direct writes."""
+        raise NotImplementedError
+
     def read_bytes(self, path: str) -> bytes:
         with self.open_read(path) as f:
             return f.read()
@@ -89,6 +95,9 @@ class LocalFS(FileSystem):
         elif os.path.exists(path):
             os.remove(path)
 
+    def rename(self, src: str, dst: str) -> None:
+        os.replace(self._strip(src), self._strip(dst))
+
 
 class ShellFS(FileSystem):
     """Remote fs through shell commands, the reference's hdfs pattern
@@ -101,13 +110,14 @@ class ShellFS(FileSystem):
 
     def __init__(self, cat_cmd: str, put_cmd: str, ls_cmd: str = "",
                  mkdir_cmd: str = "", exists_cmd: str = "",
-                 remove_cmd: str = ""):
+                 remove_cmd: str = "", rename_cmd: str = ""):
         self.cat_cmd = cat_cmd
         self.put_cmd = put_cmd
         self.ls_cmd = ls_cmd
         self.mkdir_cmd = mkdir_cmd
         self.exists_cmd = exists_cmd
         self.remove_cmd = remove_cmd
+        self.rename_cmd = rename_cmd    # template with {src} and {dst}
 
     @classmethod
     def hadoop(cls, fs_name: str = "", ugi: str = "",
@@ -125,7 +135,8 @@ class ShellFS(FileSystem):
                    ls_cmd=base + " -ls {path}",
                    mkdir_cmd=base + " -mkdir -p {path}",
                    exists_cmd=base + " -test -e {path}",
-                   remove_cmd=base + " -rm -r {path}")
+                   remove_cmd=base + " -rm -r {path}",
+                   rename_cmd=base + " -mv {src} {dst}")
 
     def _run(self, tmpl: str, path: str, **kw):
         return subprocess.Popen(tmpl.format(path=shlex.quote(path)),
@@ -169,6 +180,16 @@ class ShellFS(FileSystem):
             rc = self._run(self.remove_cmd, path).wait()
             if rc != 0:
                 raise IOError(f"fs remove failed rc={rc} for {path!r}")
+
+    def rename(self, src: str, dst: str) -> None:
+        if not self.rename_cmd:
+            raise NotImplementedError("no rename_cmd configured")
+        cmd = self.rename_cmd.format(src=shlex.quote(src),
+                                     dst=shlex.quote(dst))
+        rc = subprocess.Popen(cmd, shell=True).wait()
+        if rc != 0:
+            raise IOError(f"fs rename failed rc={rc} for "
+                          f"{src!r} -> {dst!r}")
 
 
 class _PipeReader(io.RawIOBase):

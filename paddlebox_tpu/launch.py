@@ -372,6 +372,11 @@ def main():
                     choices=("", "f32", "f16", "i8"),
                     help="wire encoding of float32 PS row payloads "
                          "(FLAGS_ps_wire_dtype; server state stays fp32)")
+    ap.add_argument("--ps_table_threads", type=int, default=None,
+                    help="host-table shard worker pool size on every "
+                         "worker (FLAGS_ps_table_threads; per-shard "
+                         "pull/write/save/load fan across it, 1 = "
+                         "sequential)")
     ap.add_argument("--obs_port", type=int, default=0,
                     help="observability exporter base port: worker rank r "
                          "serves /metrics + /statz + /tracez on "
@@ -392,6 +397,9 @@ def main():
     if args.ps_wire_dtype:
         # pboxlint: disable-next=PB203 -- env export to spawned workers
         os.environ["FLAGS_ps_wire_dtype"] = args.ps_wire_dtype
+    if args.ps_table_threads is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_ps_table_threads"] = str(args.ps_table_threads)
     proxy = None
     if args.chaos_backend:
         from paddlebox_tpu.ps.faults import ChaosProxy, FaultPlan

@@ -2,14 +2,25 @@
 
 ≙ MemorySparseTable (ps/table/memory_sparse_table.{h,cc}): shard by
 ``key % shard_num`` (memory_sparse_table.h:46-59), bulk Pull/Push
-(:61-97), Save/Load with per-shard files, Shrink via accessor policy.
+(:61-97), Save/Load with per-shard files, Shrink via accessor policy —
+and, like the reference's ``shards_task_pool_``, every per-shard loop
+fans across the shared worker pool (utils/workpool.py,
+``FLAGS_ps_table_threads``): the numpy gather/scatter that dominates a
+shard task releases the GIL, so pull/write/end_day/shrink/save/load run
+shards concurrently while staying bit-identical to the sequential walk
+(keys are unique per call; append order within a shard is owned by its
+single task).
 
 TPU-first storage: each shard keeps its keys in one insertion-ordered
 uint64 array with parallel SoA value arrays, indexed by the native C++
 open-addressing hash (native/hash_shard.cc) — bulk lookup is one threaded
 probe sweep and pass-level write-back is overwrite + append, never a
-whole-shard re-sort.  Without the native library the index falls back to a
-lazily rebuilt sorted view + ``np.searchsorted``.  This matches the
+whole-shard re-sort.  Appends land in capacity-doubling buffers (a
+``len``/``cap`` split per array; ``shard.keys``/``shard.soa`` are always
+length-trimmed views), so a pass of fresh keys costs amortized O(1)
+reallocations instead of one whole-shard ``np.concatenate`` copy per
+call.  Without the native library the index falls back to a lazily
+rebuilt sorted view + ``np.searchsorted``.  This matches the
 pass-batched access pattern (one pull at end_feed_pass, one write-back at
 end_pass) instead of the reference's per-request hash probes.
 """
@@ -25,16 +36,26 @@ import numpy as np
 
 from paddlebox_tpu.config import EmbeddingTableConfig
 from paddlebox_tpu.ps import feature_value as fv
+from paddlebox_tpu.utils import workpool
 from paddlebox_tpu.utils.monitor import stat_observe
+
+_GROW_MIN = 64      # first allocation floor (rows)
 
 
 class _Shard:
+    """One shard: insertion-ordered keys + SoA values in growable buffers.
+
+    ``keys`` and ``soa`` are ALWAYS length-trimmed views over the backing
+    capacity buffers — readers never see the uninitialized tail, and
+    in-place mutation of a view (``soa["show"] *= decay``) writes through.
+    Wholesale replacement goes through :meth:`replace` /
+    :meth:`filter_keep`, never bare attribute assignment, so the
+    ``len``/``cap`` split can't desync.
+    """
+
     def __init__(self, mf_dim: int, expand_dim: int = 0, adam: bool = False,
                  optimizer: str = "", double_stats: bool = False):
         self.optimizer = optimizer
-        self.keys = np.empty((0,), np.uint64)
-        self.soa = fv.empty_soa(0, mf_dim, expand_dim, adam, optimizer,
-                                double_stats)
         self.mf_dim = mf_dim
         # RLock: lookup lazily builds index state (native hash / sorted
         # view) and is called both bare (readers) and from under upsert
@@ -42,10 +63,61 @@ class _Shard:
         self._hash = None           # native index (row = insertion order)
         self._hash_tried = False
         self._sorted_view = None    # fallback: (sorted_keys, order)
+        # growth accounting (the amortization test asserts on these):
+        # grow_count counts buffer REALLOCATIONS, append_calls counts
+        # appends — doubling keeps grow_count O(log rows), not O(calls)
+        self.grow_count = 0
+        self.append_calls = 0
+        self._len = 0
+        self._keys_buf = np.empty((0,), np.uint64)
+        self._soa_buf = fv.empty_soa(0, mf_dim, expand_dim, adam, optimizer,
+                                     double_stats)
+        self._refresh_views()
+
+    def _refresh_views(self) -> None:
+        n = self._len
+        self.keys = self._keys_buf[:n]
+        self.soa = {f: buf[:n] for f, buf in self._soa_buf.items()}
 
     @property
     def size(self) -> int:
-        return len(self.keys)
+        return self._len
+
+    @property
+    def capacity(self) -> int:
+        return len(self._keys_buf)
+
+    def _grow(self, need: int) -> None:
+        """Reallocate every buffer to at least ``need`` rows (doubling).
+        Reentrant from upsert (which already holds the RLock)."""
+        with self.lock:
+            cap = max(len(self._keys_buf) * 2, need, _GROW_MIN)
+            nk = np.empty((cap,), np.uint64)
+            nk[:self._len] = self._keys_buf[:self._len]
+            self._keys_buf = nk
+            for f, buf in self._soa_buf.items():
+                nb = np.empty((cap,) + buf.shape[1:], buf.dtype)
+                nb[:self._len] = buf[:self._len]
+                self._soa_buf[f] = nb
+            self.grow_count += 1
+
+    def replace(self, keys: np.ndarray, soa: Dict[str, np.ndarray]) -> None:
+        """Swap in a wholesale new row set (load): the given arrays BECOME
+        the buffers (capacity == length; the next append grows)."""
+        with self.lock:
+            self._keys_buf = np.ascontiguousarray(keys, np.uint64)
+            self._len = len(self._keys_buf)
+            self._soa_buf = {f: np.ascontiguousarray(v)
+                             for f, v in soa.items()}
+            self._refresh_views()
+            self.rebuild_index()
+
+    def filter_keep(self, keep: np.ndarray) -> None:
+        """Drop rows where ``keep`` is False (shrink / spill), compacting
+        into fresh exact-size buffers."""
+        with self.lock:
+            self.replace(self.keys[keep],
+                         {f: v[keep] for f, v in self.soa.items()})
 
     def _native(self):
         # reentrant from lookup/upsert/rebuild_index, which already hold
@@ -57,9 +129,8 @@ class _Shard:
                 try:
                     from paddlebox_tpu.native import hash_map
                     if hash_map.available():
-                        h = hash_map.NativeKeyHash(max(len(self.keys),
-                                                       1024))
-                        if len(self.keys):
+                        h = hash_map.NativeKeyHash(max(self._len, 1024))
+                        if self._len:
                             h.upsert(self.keys)
                         self._hash = h
                 except Exception:
@@ -83,7 +154,7 @@ class _Shard:
         found.  Thread-safe: lazily builds index state under the shard
         lock (reentrant from upsert)."""
         with self.lock:
-            if len(self.keys) == 0:
+            if self._len == 0:
                 return (np.zeros(len(keys), np.int64),
                         np.zeros(len(keys), bool))
             h = self._native()
@@ -102,10 +173,14 @@ class _Shard:
     def upsert(self, keys: np.ndarray, soa: Dict[str, np.ndarray]) -> None:
         """Overwrite existing rows in place, append new ones — no re-sort
         (keys must be unique within one call, which pass-level write-back
-        guarantees)."""
+        guarantees).  Appends write into the buffer tail; a full buffer
+        doubles (amortized O(1) per appended row)."""
+        t_req = time.monotonic()
         with self.lock:
             # hold-time histogram: a fat p99 here is writer-side lock
-            # pressure stalling concurrent pulls (the preload thread)
+            # pressure stalling concurrent pulls (the preload thread);
+            # the WAIT histogram beside it is pool-induced queueing on a
+            # hot shard (many tasks contending for this one lock)
             t0 = time.monotonic()
             rows, found = self.lookup(keys)
             if found.any():
@@ -118,17 +193,25 @@ class _Shard:
                     # native insertion rows continue from the current size,
                     # matching the append positions exactly
                     self._hash.upsert(new_keys)
-                self.keys = np.concatenate([self.keys, new_keys])
-                for f in self.soa:
-                    self.soa[f] = np.concatenate(
-                        [self.soa[f], soa[f][~found]])
+                need = self._len + len(new_keys)
+                if need > len(self._keys_buf):
+                    self._grow(need)
+                self._keys_buf[self._len:need] = new_keys
+                for f, buf in self._soa_buf.items():
+                    buf[self._len:need] = soa[f][~found]
+                self._len = need
+                self.append_calls += 1
+                self._refresh_views()
                 self._sorted_view = None
+        stat_observe("ps.host_table.write_lock_wait_s", t0 - t_req)
         stat_observe("ps.host_table.write_lock_hold_s",
                      time.monotonic() - t0)
 
 
 class ShardedHostTable:
-    """DRAM embedding table, pass-batched API."""
+    """DRAM embedding table, pass-batched API.  Per-shard loops fan across
+    the shared worker pool (workpool.table_pool()); results are
+    bit-identical to the sequential walk at any pool size."""
 
     def __init__(self, config: EmbeddingTableConfig, seed: int = 0):
         self.config = config
@@ -153,14 +236,31 @@ class ShardedHostTable:
     def size(self) -> int:
         return sum(s.size for s in self._shards)
 
+    def grow_stats(self) -> Tuple[int, int]:
+        """→ (total buffer reallocations, total append calls) across
+        shards — the growth-amortization surface the tests assert on."""
+        return (sum(s.grow_count for s in self._shards),
+                sum(s.append_calls for s in self._shards))
+
     def _shard_ids(self, keys: np.ndarray) -> np.ndarray:
         return (keys % np.uint64(self.shard_num)).astype(np.int64)
+
+    def _shard_sel(self, keys: np.ndarray) -> List[Tuple[int, np.ndarray]]:
+        """Non-empty (shard_id, key-index array) groups for one call."""
+        sid = self._shard_ids(keys)
+        out = []
+        for s in range(self.shard_num):
+            sel = np.nonzero(sid == s)[0]
+            if len(sel):
+                out.append((s, sel))
+        return out
 
     # -- pass-batched pull/push ---------------------------------------------
     def bulk_pull(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
         """Read rows for unique `keys` (read-only; unseen keys get fresh
         default rows — insertion happens at write-back, matching the
-        build-pass flow ps_gpu_wrapper.cc:337-760)."""
+        build-pass flow ps_gpu_wrapper.cc:337-760).  One gather task per
+        shard on the pool; tasks write DISJOINT row sets of ``out``."""
         out = fv.default_rows_keyed(keys, self.mf_dim, self._seed,
                                     self.config.sgd.mf_initial_range,
                                     self.config.sgd.initial_range,
@@ -168,11 +268,11 @@ class ShardedHostTable:
                                     self.config.sgd.beta1_decay_rate,
                                     self.config.sgd.beta2_decay_rate,
                                     self.optimizer, self.double_stats)
-        sid = self._shard_ids(keys)
-        for s, shard in enumerate(self._shards):
-            sel = np.nonzero(sid == s)[0]
-            if not len(sel):
-                continue
+
+        def pull_shard(group):
+            s, sel = group
+            shard = self._shards[s]
+            t_req = time.monotonic()
             # under the shard lock: the pipelined preload thread pulls
             # concurrently with main-thread upserts that rebuild keys/soa
             with shard.lock:
@@ -183,16 +283,19 @@ class ShardedHostTable:
                     src = pos[found]
                     for f, arr in shard.soa.items():
                         out[f][hit] = arr[src]
+            stat_observe("ps.host_table.pull_lock_wait_s", t0 - t_req)
             stat_observe("ps.host_table.pull_lock_hold_s",
                          time.monotonic() - t0)
+
+        workpool.table_pool().map(pull_shard, self._shard_sel(keys))
         return out
 
     def bulk_write(self, keys: np.ndarray, soa: Dict[str, np.ndarray]) -> None:
-        sid = self._shard_ids(keys)
-        for s, shard in enumerate(self._shards):
-            sel = np.nonzero(sid == s)[0]
-            if len(sel):
-                shard.upsert(keys[sel], fv.select_rows(soa, sel))
+        def write_shard(group):
+            s, sel = group
+            self._shards[s].upsert(keys[sel], fv.select_rows(soa, sel))
+
+        workpool.table_pool().map(write_shard, self._shard_sel(keys))
 
     # -- lifecycle policy (≙ CtrCommonAccessor, ctr_accessor.cc) ------------
     def _score(self, soa: Dict[str, np.ndarray]) -> np.ndarray:
@@ -204,76 +307,101 @@ class ShardedHostTable:
         """Day rollover: decay show/click, age unseen features
         (≙ CtrCommonAccessor::UpdateStatAfterSave / show_click_decay)."""
         decay = self.config.accessor.show_click_decay_rate
-        for shard in self._shards:
+
+        def decay_shard(shard):
             with shard.lock:
                 shard.soa["show"] *= decay
                 shard.soa["click"] *= decay
                 shard.soa["unseen_days"] += 1.0
 
+        workpool.table_pool().map(decay_shard, self._shards)
+
     def shrink(self) -> int:
         """Evict dead features (≙ Table::Shrink via accessor thresholds:
         score < delete_threshold or unseen too long)."""
         acc = self.config.accessor
-        removed = 0
-        for shard in self._shards:
+
+        def shrink_shard(shard) -> int:
             with shard.lock:
                 score = self._score(shard.soa)
                 keep = ~((score < acc.delete_threshold) |
-                         (shard.soa["unseen_days"] > acc.delete_after_unseen_days))
-                removed += int((~keep).sum())
-                shard.keys = shard.keys[keep]
-                for f in shard.soa:
-                    shard.soa[f] = shard.soa[f][keep]
-                shard.rebuild_index()
-        return removed
+                         (shard.soa["unseen_days"]
+                          > acc.delete_after_unseen_days))
+                removed = int((~keep).sum())
+                if removed:
+                    shard.filter_keep(keep)
+                return removed
+
+        return sum(workpool.table_pool().map(shrink_shard, self._shards))
 
     # -- persistence (≙ SaveBase/SaveDelta box_wrapper.cc:1286; per-shard
     #    files with .shard suffix, memory_sparse_table.h:34) ----------------
     def save(self, path: str, mode: str = "base") -> int:
         """Per-shard npz dumps under `path`, which may be any registered
         filesystem scheme — e.g. hdfs://... through ShellFS
-        (≙ SaveBase/SaveDelta's AFS paths, box_wrapper.h:721-743)."""
+        (≙ SaveBase/SaveDelta's AFS paths, box_wrapper.h:721-743).  Shard
+        files write in parallel on the pool; each lands atomically
+        (tmp name + rename when the filesystem supports it), and delta
+        mode resets ``delta_score`` only AFTER its shard file is safely
+        down — a mid-save filesystem failure can't lose deltas."""
         from paddlebox_tpu.io import fs as pfs
         filesystem = pfs.get_fs(path)
         filesystem.mkdir(path)
         acc = self.config.accessor
-        saved = 0
-        for i, shard in enumerate(self._shards):
+
+        def save_shard(item) -> int:
+            i, shard = item
             with shard.lock:
                 score = self._score(shard.soa)
                 if mode == "base":
                     keep = score >= acc.base_threshold
                 elif mode == "delta":
-                    keep = np.abs(shard.soa["delta_score"]) >= acc.delta_threshold
+                    keep = np.abs(shard.soa["delta_score"]) \
+                        >= acc.delta_threshold
                 else:  # "all" / checkpoint
                     keep = np.ones(shard.size, bool)
                 data = {f: arr[keep] for f, arr in shard.soa.items()}
                 data["keys"] = shard.keys[keep]
                 part = f"{path.rstrip('/')}/part-{i:05d}.shard.npz"
-                with filesystem.open_write(part) as fh:
-                    np.savez(fh, **data)
-                saved += int(keep.sum())
+                try:
+                    tmp = part + ".tmp"
+                    with filesystem.open_write(tmp) as fh:
+                        np.savez(fh, **data)
+                    filesystem.rename(tmp, part)
+                except NotImplementedError:
+                    # scheme without a rename verb: direct write (the
+                    # pre-atomic behavior; delta reset still gated on the
+                    # write completing without raising)
+                    with filesystem.open_write(part) as fh:
+                        np.savez(fh, **data)
                 if mode == "delta":
+                    # only now is the shard file known to have landed —
+                    # zeroing before the write/rename could lose deltas
+                    # to a mid-save failure
                     shard.soa["delta_score"][keep] = 0.0
-        return saved
+                return int(keep.sum())
+
+        return sum(workpool.table_pool().map(
+            save_shard, list(enumerate(self._shards))))
 
     def load(self, path: str) -> int:
         from io import BytesIO
 
         from paddlebox_tpu.io import fs as pfs
         filesystem = pfs.get_fs(path)
-        loaded = 0
-        for i, shard in enumerate(self._shards):
+
+        def load_shard(item) -> int:
+            i, shard = item
             f = f"{path.rstrip('/')}/part-{i:05d}.shard.npz"
             if not filesystem.exists(f):
-                continue
+                return 0
             fh = filesystem.open_read(f)
             # np.load needs seek; only pipe-backed streams buffer fully
             src = fh if fh.seekable() else BytesIO(fh.read())
             with np.load(src) as z:
                 with shard.lock:
-                    shard.keys = z["keys"]
-                    n = len(shard.keys)
+                    new_keys = z["keys"]
+                    n = len(new_keys)
                     # checkpoints from a different optimizer config may
                     # lack some state fields (e.g. adam moments when the
                     # save ran under adagrad) — init those like fresh rows
@@ -300,9 +428,11 @@ class ShardedHostTable:
                         return arr.astype(tmpl.dtype) \
                             if arr.dtype != tmpl.dtype else arr
 
-                    shard.soa = {name: from_ckpt(name, tmpl)
-                                 for name, tmpl in shard.soa.items()}
-                    shard.rebuild_index()
+                    shard.replace(new_keys,
+                                  {name: from_ckpt(name, tmpl)
+                                   for name, tmpl in shard.soa.items()})
             fh.close()
-            loaded += shard.size
-        return loaded
+            return shard.size
+
+        return sum(workpool.table_pool().map(
+            load_shard, list(enumerate(self._shards))))

@@ -380,6 +380,19 @@ class BoxPSEngine:
             f"pipeline_stall={delta('ps.client.pipeline_stall_s'):.3f}s "
             f"retries={int(delta('ps.client.retry'))} "
             f"dedup_hits={int(delta('ps.server.dedup_hit'))}")
+        pool_tasks = delta("ps.pool.table.tasks")
+        if pool_tasks:
+            # shard-pool pressure for THIS pass: busy seconds across
+            # workers, plus the process-lifetime queue/active high-water
+            # marks — the at-a-glance answer to "is the table apply
+            # pool-parallel or queueing on a hot shard?"
+            lines.append(
+                f"  pool table: tasks={int(pool_tasks)} "
+                f"busy={delta('ps.pool.table.busy_s'):.3f}s "
+                f"threads={int(cur.get('ps.pool.table.threads', 1))} "
+                f"queue_hwm={int(cur.get('ps.pool.table.queue_depth_hwm', 0))} "
+                f"active_hwm={int(cur.get('ps.pool.table.active_hwm', 0))} "
+                f"util_p95={cur.get('ps.pool.table.utilization.p95', 0.0):.2f}")
         faults_n = sum(delta(k) for k in cur if k.startswith("ps.fault."))
         if faults_n:
             lines.append(f"  injected_faults={int(faults_n)}")

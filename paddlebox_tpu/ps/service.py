@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import logging
 import os
+import queue
 import socket
 import socketserver
 import struct
@@ -299,13 +300,29 @@ class PSServer:
                     outer._conns.discard(self.request)
 
             def handle(self):
-                while True:
+                # per-connection decode/apply pipeline: this thread recvs
+                # AND DECODES frame i+1 while the dispatcher thread applies
+                # frame i to the table and sends its response — a pipelined
+                # multi-chunk verb overlaps chunk decode with the previous
+                # chunk's table apply.  Responses stay strictly in request
+                # order (one dispatcher, FIFO queue), which the client's
+                # per-stream receiver requires.  The bounded queue (one
+                # decoded frame of lookahead) keeps memory flat.
+                q: "queue.Queue" = queue.Queue(maxsize=2)
+                state = {"open": True}
+
+                def abort_conn():
+                    # wake this handler out of a blocked _recv so handle()
+                    # returns and socketserver closes the connection (the
+                    # client sees the same drop as the old inline path)
                     try:
-                        req = _recv(self.request, role="server")
-                    except (ConnectionError, OSError, wire.DecodeError):
-                        # malformed frame → stream sync is gone; drop the
-                        # connection (client reconnects + retries)
-                        return
+                        self.request.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+                def dispatch_one(req) -> bool:
+                    """Apply + respond to one decoded request; False ends
+                    the connection (same exits as the old inline loop)."""
                     with outer._inflight_cv:
                         outer._inflight += 1
                     try:
@@ -315,7 +332,7 @@ class PSServer:
                             # injected mid-verb death: no response — the
                             # client's retry resolves through the dedup
                             # window (or a clean re-execute)
-                            return
+                            return False
                         except Exception as e:  # noqa: BLE001
                             resp = {"ok": False, "error": repr(e)}
                             if wire.RID_FIELD in req:
@@ -338,15 +355,48 @@ class PSServer:
                             try:
                                 _send(self.request, err, role="server")
                             except (RuntimeError, ConnectionError, OSError):
-                                return
+                                return False
                         except (ConnectionError, OSError):
-                            return
+                            return False
                     finally:
                         with outer._inflight_cv:
                             outer._inflight -= 1
                             outer._inflight_cv.notify_all()
-                    if outer._draining:
-                        return              # drain: finish-current, then out
+                    return not outer._draining  # drain: finish-current, out
+
+                def dispatcher():
+                    while True:
+                        try:
+                            req = q.get(timeout=0.25)
+                        except queue.Empty:
+                            if not state["open"]:
+                                return
+                            continue
+                        if not dispatch_one(req):
+                            abort_conn()
+                            return
+
+                t = threading.Thread(target=dispatcher, daemon=True)
+                t.start()
+                try:
+                    while True:
+                        try:
+                            req = _recv(self.request, role="server")
+                        except (ConnectionError, OSError, wire.DecodeError):
+                            # malformed frame → stream sync is gone; drop
+                            # the connection (client reconnects + retries)
+                            return
+                        while t.is_alive():
+                            try:
+                                q.put(req, timeout=0.25)
+                                break
+                            except queue.Full:
+                                continue
+                        if not t.is_alive():
+                            return      # dispatcher ended the connection
+                finally:
+                    state["open"] = False
+                    t.join()
 
         self._srv = _ThreadingTCPServer((host, port), Handler,
                                         bind_and_activate=True)

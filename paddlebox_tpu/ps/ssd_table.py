@@ -21,6 +21,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from paddlebox_tpu.ps import feature_value as fv
+from paddlebox_tpu.utils import workpool
 
 _MAGIC = b"PBOXSSD1"
 
@@ -190,53 +191,60 @@ class SSDTieredTable:
         return self.spill(thr)
 
     def spill(self, score_threshold: float) -> int:
-        """Demote host rows with score < threshold to SSD."""
-        spilled = 0
-        for si, shard in enumerate(self.host._shards):
+        """Demote host rows with score < threshold to SSD.  One task per
+        shard on the shared pool (each pairs a host shard with its own
+        SSD log — no cross-shard state)."""
+
+        def spill_shard(si: int) -> int:
+            shard = self.host._shards[si]
             with shard.lock:
                 score = self.host._score(shard.soa)
                 cold = score < score_threshold
                 if not cold.any():
-                    continue
+                    return 0
                 keys = shard.keys[cold]
                 soa = {f: arr[cold] for f, arr in shard.soa.items()}
                 self.shards[si].write_rows(keys, soa)
-                keep = ~cold
-                shard.keys = shard.keys[keep]
-                for f in shard.soa:
-                    shard.soa[f] = shard.soa[f][keep]
-                shard.rebuild_index()
-                spilled += int(cold.sum())
-        return spilled
+                shard.filter_keep(~cold)
+                return int(cold.sum())
+
+        return sum(workpool.table_pool().map(
+            spill_shard, range(self.host.shard_num)))
 
     def bulk_pull(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
         """Host rows, faulting SSD-resident rows back into DRAM
-        (≙ LoadSSD2Mem box_wrapper.h:640)."""
+        (≙ LoadSSD2Mem box_wrapper.h:640).  The batched fault-in fans one
+        task per shard: every key a task touches lives in that shard, so
+        promotion upserts the host shard DIRECTLY (never back through the
+        pooled bulk_write — a pool task waiting on nested pool futures
+        could starve)."""
         out = self.host.bulk_pull(keys)
         # determine which keys were absent from DRAM → try SSD
         sid = self._shard_ids(keys)
-        for si in range(self.host.shard_num):
+
+        def fault_in(si: int) -> None:
             sel = np.nonzero(sid == si)[0]
             if not len(sel):
-                continue
+                return
             _, in_dram = self.host._shards[si].lookup(keys[sel])
             miss = sel[~in_dram]
             if not len(miss):
-                continue
+                return
             soa, found = self.shards[si].read_rows(keys[miss])
             hit = miss[found]
             if len(hit):
                 for f in out:
                     out[f][hit] = soa[f][found]
                 # promote back to DRAM and drop from SSD
-                self.host.bulk_write(
+                self.host._shards[si].upsert(
                     keys[hit], {f: out[f][hit] for f in out})
                 self.shards[si].delete(keys[hit])
+
+        workpool.table_pool().map(fault_in, range(self.host.shard_num))
         return out
 
     def total_size(self) -> int:
         return self.host.size() + sum(len(s) for s in self.shards)
 
     def compact(self) -> None:
-        for s in self.shards:
-            s.compact()
+        workpool.table_pool().map(lambda s: s.compact(), self.shards)

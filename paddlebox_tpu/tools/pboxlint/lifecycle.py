@@ -10,6 +10,12 @@
          (``break``/``return``) nor an exception handler — the consumer
          hang class seen in channel/pass-feed code: the producer dies, the
          loop blocks forever.
+  PB403  a ``ThreadPoolExecutor(...)`` created without a
+         ``thread_name_prefix=`` (anonymous pool threads make stack dumps
+         and the workpool re-entrancy guard unreadable/unworkable), OR
+         one that is never ``shutdown()``-ed in its owning scope and not
+         managed by a ``with`` statement — its non-daemon workers hang
+         interpreter shutdown exactly like a forgotten PB401 thread.
 
 Queue-typed receivers are recognized syntactically: any name (local or
 ``self.X``) assigned from a ``queue.Queue``-family constructor or from a
@@ -201,5 +207,68 @@ def _check_queue_gets(mod: Module) -> List[Finding]:
     return findings
 
 
+def _is_executor_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    return dotted_name(node.func).rsplit(".", 1)[-1] == "ThreadPoolExecutor"
+
+
+def _check_executors(mod: Module) -> List[Finding]:
+    findings: List[Finding] = []
+    parent = {}
+    for node in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(node):
+            parent[child] = node
+
+    def owning_scope(node: ast.AST, want_class: bool) -> ast.AST:
+        cur = parent.get(node)
+        while cur is not None:
+            if want_class and isinstance(cur, ast.ClassDef):
+                return cur
+            if not want_class and isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = parent.get(cur)
+        return mod.tree
+
+    # ctors managed by a `with` statement: shutdown is implicit
+    with_managed = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if _is_executor_ctor(item.context_expr):
+                    with_managed.add(id(item.context_expr))
+
+    for node in ast.walk(mod.tree):
+        if not _is_executor_ctor(node):
+            continue
+        call = node
+        if not any(kw.arg == "thread_name_prefix" for kw in call.keywords):
+            findings.append(Finding(
+                mod.path, call.lineno, "PB403",
+                "ThreadPoolExecutor created without thread_name_prefix= — "
+                "anonymous pool threads make stack dumps unattributable "
+                "and defeat name-based re-entrancy guards"))
+        if id(call) in with_managed:
+            continue                     # `with` handles shutdown
+        assigned = parent.get(call)
+        ok = False
+        if isinstance(assigned, ast.Assign):
+            for name, is_self in map(_target_name, assigned.targets):
+                if name is None:
+                    continue
+                scope = owning_scope(call, want_class=is_self)
+                if (name, is_self) in _method_calls_on(scope, "shutdown"):
+                    ok = True
+        if not ok:
+            findings.append(Finding(
+                mod.path, call.lineno, "PB403",
+                "ThreadPoolExecutor is never shutdown() in its owning "
+                "scope (and not managed by a `with` statement) — its "
+                "non-daemon workers hang interpreter shutdown"))
+    return findings
+
+
 def check(mod: Module, ctx: PackageContext) -> List[Finding]:
-    return _check_threads(mod) + _check_queue_gets(mod)
+    return (_check_threads(mod) + _check_queue_gets(mod)
+            + _check_executors(mod))

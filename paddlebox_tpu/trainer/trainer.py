@@ -978,7 +978,8 @@ class SparseTrainer:
 
         import concurrent.futures
         pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=max(1, pack_threads))
+            max_workers=max(1, pack_threads),
+            thread_name_prefix="pbox-pack")
 
         def pack_one(block):
             t0 = time.perf_counter()

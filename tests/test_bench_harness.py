@@ -134,6 +134,9 @@ def test_supervisor_kills_hung_backend_and_reports(tmp_path):
     assert "wedged" in line.get("error", "")
     assert line["supervisor_attempts"] >= 2      # it retried
     assert "killing" in err
+    log = line.get("attempt_log")
+    assert log and len(log) == line["supervisor_attempts"]
+    assert all(e["last_phase"] == "backend-init" for e in log)
 
 
 def test_supervisor_recovers_from_transient_hang(tmp_path):
@@ -170,6 +173,12 @@ def test_supervisor_falls_back_to_cpu_after_wedge():
     assert "backend up: cpu" in err                 # fallback reached a backend
     assert line.get("platform_fallback") == "cpu"
     assert "post-fallback-marker" in line.get("error", "")
+    # the final JSON names each attempt's platform and dying phase —
+    # a failed round is diagnosable from the result line alone
+    log = line.get("attempt_log")
+    assert log and log[0]["platform"] == "default"
+    assert log[0]["last_phase"] == "backend-init"
+    assert all(e["platform"] == "cpu" for e in log[1:])
 
 
 def test_better_prefers_clean_full_over_higher_value_smoke(bench):

@@ -429,6 +429,70 @@ def test_pb402_queue_gated_loop_is_fine():
     assert codes(src) == []
 
 
+def test_pb403_executor_missing_prefix_and_shutdown():
+    src = """
+    import concurrent.futures
+
+    def bad():
+        pool = concurrent.futures.ThreadPoolExecutor(max_workers=4)
+        pool.submit(print, 1)
+    """
+    # two distinct defects on the one ctor: anonymous threads AND a
+    # forgotten lifecycle
+    assert codes(src) == ["PB403", "PB403"]
+
+
+def test_pb403_with_statement_still_needs_prefix():
+    src = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    def run(items):
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            return list(pool.map(str, items))
+    """
+    # `with` covers shutdown; the missing prefix alone trips
+    assert codes(src) == ["PB403"]
+
+
+def test_pb403_negative_prefixed_and_shutdown():
+    src = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    def fn(items):
+        ex = ThreadPoolExecutor(max_workers=2, thread_name_prefix="pk")
+        try:
+            return [f.result() for f in [ex.submit(str, i) for i in items]]
+        finally:
+            ex.shutdown(wait=False)
+
+    def ctx(items):
+        with ThreadPoolExecutor(max_workers=2,
+                                thread_name_prefix="pk") as pool:
+            return list(pool.map(str, items))
+
+    class Owner:
+        def __init__(self):
+            self._ex = ThreadPoolExecutor(max_workers=1,
+                                          thread_name_prefix="pk")
+
+        def close(self):
+            self._ex.shutdown()
+    """
+    assert codes(src) == []
+
+
+def test_pb403_class_attr_without_shutdown():
+    src = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    class Leaky:
+        def __init__(self):
+            self._ex = ThreadPoolExecutor(max_workers=1,
+                                          thread_name_prefix="pk")
+    """
+    assert codes(src) == ["PB403"]
+
+
 # -- suppressions ------------------------------------------------------------
 
 # -- PB5xx retry/backoff discipline ------------------------------------------
