@@ -36,11 +36,23 @@ because no later phase exists until a backend does.  The child's own
 thread watchdog still handles post-backend phase hangs.  The supervisor
 always prints the final stdout line (best result seen across attempts).
 
+Wedge postmortems (utils/doctor.py): when a phase budget expires the
+child writes a full postmortem bundle (all-thread stacks + flight ring +
+stat snapshot) BEFORE emitting its error line; the bundle path rides the
+error line and the supervisor's attempt_log — a wedged round ships
+stacks, not a mystery.  SIGUSR1 on the child dumps one live.
+
+Compare mode: ``bench.py --compare OLD.json NEW.json [--threshold=0.05]``
+diffs two BENCH result files (throughput, feed_gap_ratio, obs_stats
+movers) and exits nonzero on regression beyond the threshold — the
+recorded CPU-basis bench delta the ROADMAP asks every perf PR to carry.
+
 Env knobs: BENCH_BATCH_SIZE, BENCH_BATCHES, BENCH_KEYS, BENCH_TIMEOUT_S,
 BENCH_PACK_THREADS, BENCH_SKIP_SMOKE=1, BENCH_SMOKE_ONLY=1,
 BENCH_LEGACY_FEED=1 (per-batch host pack path), BENCH_STEP_PROFILE=0,
 BENCH_BACKEND_ATTEMPT_S (per-attempt backend-init window, default 150),
-BENCH_NO_SUPERVISE=1 (single-process debug mode).
+BENCH_NO_SUPERVISE=1 (single-process debug mode),
+BENCH_COMPARE_THRESHOLD (default regression threshold for --compare).
 """
 
 import json
@@ -80,6 +92,11 @@ def set_phase(name: str, budget_s: float) -> None:
         _STATE["phase"] = name
         _STATE["deadline"] = min(time.time() + budget_s, hard)
     trace(f"phase={name} budget={budget_s:.0f}s")
+    try:  # phase boundaries belong in the flight ring: a postmortem's
+        from paddlebox_tpu.utils import flight  # event tail then shows
+        flight.record("bench_phase", phase=name, budget_s=budget_s)
+    except Exception:  # how far the run got before wedging
+        pass
 
 
 def record(**kw) -> None:
@@ -129,6 +146,7 @@ def _watchdog() -> None:
     XLA compile, where SIGALRM handlers never run): on phase-budget expiry
     emit the best partial value + the wedged phase name, then hard-exit."""
     while True:
+        # pboxlint: disable-next=PB501 -- fixed poll cadence, not a retry
         time.sleep(2)
         with _LOCK:
             if _STATE["done"]:
@@ -137,9 +155,20 @@ def _watchdog() -> None:
             phase = _STATE["phase"]
             partial = dict(_STATE["partial"])
         if expired:
+            # postmortem FIRST, error line second: the bundle (all-thread
+            # stacks + flight tail + stat snapshot) is the whole point of
+            # a wedge report, and os._exit below forecloses any later shot
+            pm = None
+            try:
+                from paddlebox_tpu.utils import doctor
+                pm = doctor.write_postmortem(
+                    reason=f"watchdog: phase '{phase}' exceeded its budget")
+                trace(f"watchdog: postmortem {pm}")
+            except Exception as e:  # never let diagnostics block the emit
+                trace(f"watchdog: postmortem failed: {e!r}")
             emit(_best(),
                  error=f"watchdog: phase '{phase}' exceeded its budget",
-                 last_phase=phase, partial=partial,
+                 last_phase=phase, partial=partial, postmortem=pm,
                  elapsed_s=round(time.time() - T0, 1))
             os._exit(0)
 
@@ -151,7 +180,7 @@ def _obs_snapshot():
     try:
         from paddlebox_tpu.utils.monitor import stat_snapshot
         obs = {}
-        for prefix in ("ps.", "data.", "trainer."):
+        for prefix in ("ps.", "data.", "trainer.", "feed."):
             obs.update(stat_snapshot(prefix))
         return {k: round(v, 6) if isinstance(v, float) else v
                 for k, v in sorted(obs.items())}
@@ -416,6 +445,7 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
         set_phase(f"{tag}:e2e[batch {n}/{n_batches}]", 120)
 
     t0 = time.perf_counter()
+    m0 = time.monotonic()
     if legacy:
         stats = trainer.train_pass(dataset, prefetch=8,
                                    pack_threads=pack_threads,
@@ -426,6 +456,19 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     e2e_eps = n_examples / dt
     record(**{("e2e" if tag == "full" else f"{tag}_e2e"): round(e2e_eps, 1)})
     trace(f"{tag}: e2e={e2e_eps:,.0f} ex/s over {dt:.1f}s")
+
+    # interval-level feed-gap attribution over the e2e window (report()
+    # clips to [m0, now], so earlier phases' intervals don't leak in)
+    feed_rep = {}
+    try:
+        from paddlebox_tpu.utils import intervals
+        feed_rep = intervals.report(since=m0)
+        trace(f"{tag}: device_busy_frac={feed_rep['device_busy_frac']:.3f} "
+              f"feed_gap_ratio={feed_rep['feed_gap_ratio']:.2f}")
+    except Exception as e:  # attribution is diagnostic, never fatal
+        trace(f"{tag}: interval report failed: {type(e).__name__}: {e}")
+    record(**{f"{tag}_device_busy_frac":
+              round(feed_rep.get("device_busy_frac", 0.0), 4)})
 
     step_ms = {}
     if tag == "full" and not legacy \
@@ -442,6 +485,10 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
             "auc": round(float(stats.get("auc", float("nan"))), 4),
             "compile_s": round(compile_s, 1), "pass_pack_s": round(pack_s, 1),
             "amp": amp, "step_ms": step_ms, "trim_frac": round(trim_frac, 3),
+            "device_busy_frac": round(feed_rep.get("device_busy_frac", 0.0), 4),
+            "feed_gap_ratio": round(feed_rep.get("feed_gap_ratio", 0.0), 2),
+            "feed_intervals": {k: round(v, 3)
+                               for k, v in sorted(feed_rep.items())},
             "timers": trainer.timers.report()}
 
 
@@ -477,6 +524,17 @@ def run() -> None:
     fail = os.environ.get("BENCH_TEST_FAIL_AFTER_INIT")
     if fail:    # harness-test hook: deterministic post-backend failure
         raise RuntimeError(fail)
+    if os.environ.get("BENCH_TEST_WEDGE_PHASE") == "1":
+        # harness-test hook: a post-backend wedge with a recognizably
+        # named stuck thread — exercises watchdog → postmortem → error
+        # line end to end (the postmortem must name phase and thread)
+        def _wedge_sleep():     # python frame so the postmortem shows it
+            time.sleep(10 ** 6)
+        threading.Thread(target=_wedge_sleep,
+                         name="wedge-sleeper", daemon=True).start()
+        set_phase("wedge-sim",
+                  float(os.environ.get("BENCH_TEST_WEDGE_BUDGET_S", 3)))
+        time.sleep(10 ** 6)
 
     if os.environ.get("BENCH_SKIP_SMOKE") != "1":
         smoke = run_config(
@@ -505,12 +563,20 @@ def run() -> None:
          auc=full["auc"], backend=backend, pack_threads=PACK_THREADS,
          compile_s=full["compile_s"], pass_pack_s=full["pass_pack_s"],
          amp=full["amp"], step_ms=full["step_ms"],
-         trim_frac=full["trim_frac"], timers=full["timers"],
+         trim_frac=full["trim_frac"],
+         device_busy_frac=full["device_busy_frac"],
+         feed_gap_ratio=full["feed_gap_ratio"],
+         feed_intervals=full["feed_intervals"], timers=full["timers"],
          obs_stats=_obs_snapshot())
 
 
 def child_main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
+    try:
+        from paddlebox_tpu.utils import doctor
+        doctor.install()   # kill -USR1 <child> dumps a live postmortem
+    except Exception:
+        pass
     try:
         run()
     except Exception as e:
@@ -698,7 +764,10 @@ def supervise() -> None:
                 and _rank(attempt_best)[0] == 2
                 else (attempt_best or {}).get("stage", "no-output")),
             "error": (attempt_best or {}).get("error")
-            or (f"rc={proc.returncode}" if proc.returncode else None)})
+            or (f"rc={proc.returncode}" if proc.returncode else None),
+            # child watchdog wrote a stack bundle before dying — carry its
+            # path so a wedged attempt is debuggable from the result JSON
+            "postmortem": (attempt_best or {}).get("postmortem")})
         if attempt_best is not None and _rank(attempt_best)[0] == 2 \
                 and float(attempt_best.get("value") or 0) > 0:
             break                     # clean TERMINAL result — done
@@ -753,7 +822,88 @@ def supervise() -> None:
     sys.exit(0)
 
 
+# ---------------------------------------------------------------------------
+# Compare mode: diff two recorded BENCH result files.
+# ---------------------------------------------------------------------------
+
+def _load_result(path):
+    """Load a BENCH result: either a raw result line (has "metric") or the
+    driver's wrapper file whose "parsed" key holds the result line."""
+    with open(path) as f:
+        obj = json.load(f)
+    if isinstance(obj, dict) and "metric" in obj:
+        return obj
+    if isinstance(obj, dict) and isinstance(obj.get("parsed"), dict):
+        return obj["parsed"]
+    raise ValueError(f"{path}: not a BENCH result file "
+                     "(no 'metric' or 'parsed' key)")
+
+
+def compare(old_path: str, new_path: str, threshold=None) -> int:
+    """Diff two BENCH result files; 0 = within threshold, 1 = regression.
+
+    Regressions: headline value drops by more than the threshold fraction,
+    or feed_gap_ratio grows by more than it.  obs_stats movers beyond the
+    threshold are reported (informational — counters legitimately move)."""
+    if threshold is None:
+        threshold = float(os.environ.get("BENCH_COMPARE_THRESHOLD", 0.05))
+    old, new = _load_result(old_path), _load_result(new_path)
+
+    def num(d, k):
+        v = d.get(k)
+        return float(v) if isinstance(v, (int, float)) \
+            and math.isfinite(float(v)) else None
+
+    out = {"old": old_path, "new": new_path, "threshold": threshold}
+    regressions = []
+    vo, vn = num(old, "value"), num(new, "value")
+    if vo and vn is not None:           # lower throughput = regression
+        frac = (vn - vo) / vo
+        out["value"] = {"old": vo, "new": vn, "delta_frac": round(frac, 4)}
+        if frac < -threshold:
+            regressions.append(
+                f"value {vo:.1f} -> {vn:.1f} ({frac:+.1%})")
+    go, gn = num(old, "feed_gap_ratio"), num(new, "feed_gap_ratio")
+    if go and gn is not None:           # higher feed gap = regression
+        gfrac = (gn - go) / go
+        out["feed_gap_ratio"] = {"old": go, "new": gn,
+                                 "delta_frac": round(gfrac, 4)}
+        if gfrac > threshold:
+            regressions.append(
+                f"feed_gap_ratio {go:.2f} -> {gn:.2f} ({gfrac:+.1%})")
+    oo = old.get("obs_stats") or {}
+    on = new.get("obs_stats") or {}
+    movers = []
+    for k in set(oo) & set(on):
+        a, b = oo[k], on[k]
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)) \
+                and (a or b):
+            rel = abs(b - a) / max(abs(a), abs(b))
+            if rel > threshold:
+                movers.append((rel, k, a, b))
+    movers.sort(reverse=True)
+    out["obs_deltas"] = {k: {"old": a, "new": b}
+                         for _, k, a, b in movers[:20]}
+    out["regressions"] = regressions
+    out["ok"] = not regressions
+    print(json.dumps(_san(out), indent=1), flush=True)
+    return 1 if regressions else 0
+
+
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--compare":
+        thr = None
+        paths = []
+        for a in sys.argv[2:]:
+            if a.startswith("--threshold="):
+                thr = float(a.split("=", 1)[1])
+            else:
+                paths.append(a)
+        if len(paths) != 2:
+            print("usage: bench.py --compare OLD.json NEW.json "
+                  "[--threshold=0.05]", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(compare(paths[0], paths[1], threshold=thr))
     if os.environ.get("BENCH_CHILD") == "1" \
             or os.environ.get("BENCH_NO_SUPERVISE") == "1":
         child_main()

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from paddlebox_tpu.config import DataFeedConfig, SlotConfig
 from paddlebox_tpu.data.batch_pack import BatchPacker
 from paddlebox_tpu.data.slot_record import SlotRecordBlock
+from paddlebox_tpu.utils import intervals
 from paddlebox_tpu.utils.monitor import stat_observe
 
 
@@ -101,6 +102,7 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
     blocks are concatenated and sliced densely every batch_size records.
     """
     t_pack = time.perf_counter()
+    m_pack = time.monotonic()
     packer = BatchPacker(feed_config, batch_size, label_slot)
     blocks = list(blocks)
     merged = SlotRecordBlock.concat(blocks)
@@ -234,6 +236,7 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
     # pass-feed pack latency: whole-pass + amortized per-batch (the host
     # cost the pass-resident feed exists to keep out of the train loop)
     dt = time.perf_counter() - t_pack
+    intervals.record("pack", m_pack, time.monotonic())
     stat_observe("data.pass_feed.pack_s", dt)
     stat_observe("data.pass_feed.batch_pack_s", dt / max(1, n_batches))
     return out
@@ -359,6 +362,7 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
     materializes on a single device; the relayout then runs under GSPMD and
     the result is device_put to the final batch-dim shardings."""
     t_up = time.perf_counter()
+    m_up = time.monotonic()
     h = host_arrays
     N, B = h.n_batches, h.batch_size
     in_shardings = {}
@@ -404,6 +408,7 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
     if sharding is not None:
         data = {k: jax.device_put(v, sharding[k]) if k in sharding else v
                 for k, v in data.items()}
+    intervals.record("upload", m_up, time.monotonic())
     stat_observe("data.pass_feed.upload_s", time.perf_counter() - t_up)
     return PackedPassFeed(data=data, n_batches=N, batch_size=B,
                           num_real=h.num_real,

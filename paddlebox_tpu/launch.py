@@ -22,6 +22,8 @@ import sys
 import time
 from typing import Dict, List, Optional
 
+from paddlebox_tpu.utils import flight
+
 
 def init_distributed(coordinator: Optional[str] = None,
                      num_processes: Optional[int] = None,
@@ -30,11 +32,14 @@ def init_distributed(coordinator: Optional[str] = None,
     Reads PBOX_* env set by the launcher when args are omitted.  Returns
     this process's rank.  No-op for single-process jobs."""
     import jax
-    from paddlebox_tpu.utils import obs_server
+    from paddlebox_tpu.utils import doctor, obs_server
     # worker-side observability entry: FLAGS_obs_port (assigned base+rank
     # by the launcher) starts the /metrics exporter; FLAGS_obs_trace the
-    # span tracer — both no-ops when unset
+    # span tracer — both no-ops when unset.  The wedge doctor's SIGUSR1
+    # handler makes every worker live-interrogable (kill -USR1 <pid>
+    # writes a postmortem bundle under FLAGS_obs_postmortem_dir).
     obs_server.maybe_start_from_flags()
+    doctor.install()
     num = num_processes if num_processes is not None else \
         int(os.environ.get("PBOX_WORLD_SIZE", "1"))
     if num <= 1:
@@ -95,7 +100,11 @@ def launch(script: str, script_args: List[str], nproc: int,
         from paddlebox_tpu.utils import obs_server
         for r, p in enumerate(procs):
             if p is not None and p.poll() is None:
-                snap = obs_server.scrape(obs_port + r)
+                # raw=1 ships each worker's histogram buckets so the
+                # merged percentiles are recomputed bucket-wise instead
+                # of max-of-percentiles (obs_server.merge_snapshots)
+                snap = obs_server.scrape(obs_port + r,
+                                         path="/statz?raw=1")
                 if snap:
                     obs_last[r] = snap
         if final and obs_last:
@@ -120,6 +129,8 @@ def launch(script: str, script_args: List[str], nproc: int,
                     alive += 1
                 elif ret != 0 and restarts[r] < max_restarts:
                     restarts[r] += 1
+                    flight.record("worker_restart", rank=r, code=ret,
+                                  restarts=restarts[r])
                     procs[r] = spawn(r)
                     alive += 1
                 elif ret != 0:
@@ -335,6 +346,14 @@ def launch_elastic(script: str, script_args: List[str], nproc: int,
         if new_world < min_workers:
             return 76                   # below quorum
         gen += 1
+        if new_world > world:
+            flight.record("elastic_grow", gen=gen, world=new_world,
+                          grew=new_world - world)
+        elif new_world < world:
+            flight.record("elastic_scale_in", gen=gen, world=new_world,
+                          lost=len(lost), crashed=len(crashed))
+        flight.record("elastic_rerendezvous", gen=gen, world=new_world,
+                      survivors=survivors, grow=grow)
         world = new_world
         procs = {r: spawn(r, world, gen) for r in range(world)}
         seen_hb = set()
@@ -379,10 +398,20 @@ def main():
                          "sequential)")
     ap.add_argument("--obs_port", type=int, default=0,
                     help="observability exporter base port: worker rank r "
-                         "serves /metrics + /statz + /tracez on "
-                         "obs_port + r (FLAGS_obs_port); the launcher "
-                         "scrapes all workers and prints one merged "
-                         "snapshot at job end.  0 = off")
+                         "serves /metrics + /statz + /tracez + /flightz "
+                         "+ /debugz on obs_port + r (FLAGS_obs_port); "
+                         "the launcher scrapes all workers and prints one "
+                         "merged snapshot at job end.  0 = off")
+    ap.add_argument("--obs_flight_ring", type=int, default=None,
+                    help="flight-recorder ring capacity on every worker "
+                         "(FLAGS_obs_flight_ring; newest-N lifecycle "
+                         "events served as /flightz and embedded in "
+                         "postmortems).  0 disables")
+    ap.add_argument("--obs_postmortem_dir", default="",
+                    help="directory for wedge-doctor postmortem bundles "
+                         "(FLAGS_obs_postmortem_dir; SIGUSR1 on any "
+                         "worker writes one).  empty = <tmpdir>/"
+                         "pbox-postmortems")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
@@ -400,6 +429,12 @@ def main():
     if args.ps_table_threads is not None:
         # pboxlint: disable-next=PB203 -- env export to spawned workers
         os.environ["FLAGS_ps_table_threads"] = str(args.ps_table_threads)
+    if args.obs_flight_ring is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_obs_flight_ring"] = str(args.obs_flight_ring)
+    if args.obs_postmortem_dir:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_obs_postmortem_dir"] = args.obs_postmortem_dir
     proxy = None
     if args.chaos_backend:
         from paddlebox_tpu.ps.faults import ChaosProxy, FaultPlan

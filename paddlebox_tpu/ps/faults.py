@@ -44,6 +44,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from paddlebox_tpu import flags
+from paddlebox_tpu.utils import flight
 from paddlebox_tpu.utils.monitor import stat_add
 
 flags.define_flag(
@@ -180,6 +181,8 @@ class FaultPlan:
                     hit = rule.action
         if hit is not None:
             stat_add(f"ps.fault.{site}.{hit.kind}")
+            flight.record("fault_injected", site=site, action=hit.kind,
+                          role=role, cmd=cmd)
         return hit
 
     def hits(self, site: str, role: Optional[str] = None) -> int:

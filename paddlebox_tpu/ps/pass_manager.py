@@ -33,8 +33,8 @@ from paddlebox_tpu.config import EmbeddingTableConfig
 from paddlebox_tpu.parallel.topology import HybridTopology
 from paddlebox_tpu.ps import embedding
 from paddlebox_tpu.ps.host_table import ShardedHostTable
-from paddlebox_tpu.utils import trace
-from paddlebox_tpu.utils.monitor import stat_add, stat_snapshot
+from paddlebox_tpu.utils import flight, intervals, trace
+from paddlebox_tpu.utils.monitor import stat_add, stat_set, stat_snapshot
 from paddlebox_tpu.utils.timer import TimerRegistry
 
 flags.define_flag(
@@ -81,6 +81,7 @@ class BoxPSEngine:
     # -- date / phase --------------------------------------------------------
     def set_date(self, date: str) -> None:
         if self.day_id is not None and date != self.day_id:
+            flight.record("day_end", day=self.day_id, next_day=date)
             with self.timers("end_day"):
                 self.table.end_day()
         self.day_id = date
@@ -98,6 +99,11 @@ class BoxPSEngine:
         # pass only).  Coordinator-only, like the lifecycle flag below.
         self._pass_stats0 = stat_snapshot("ps.")
         self._pass_timers0 = {n: (s, c) for n, s, c in self.timers.rows()}
+        # feed-gap window anchor: end_pass computes the pass's
+        # device_busy_frac / feed_gap_ratio over [here, write-back done]
+        self._pass_m0 = time.monotonic()
+        flight.record("pass_feed_begin", pass_id=self.pass_id + 1,
+                      day=self.day_id)
         # the pass lifecycle is driven by one coordinator thread;
         # _agent_lock only guards the add_keys sink
         # pboxlint: disable-next=PB102 -- single-coordinator lifecycle flag
@@ -128,7 +134,9 @@ class BoxPSEngine:
                 trace.span("ps.engine.build_pull", keys=len(uniq)):
             t0 = time.monotonic()
             host_rows = self.table.bulk_pull(uniq)
-            stat_add("ps.engine.build_pull_s", time.monotonic() - t0)
+            t1 = time.monotonic()
+            intervals.record("pull", t0, t1)
+            stat_add("ps.engine.build_pull_s", t1 - t0)
             stat_add("ps.engine.build_pull_rows", float(len(uniq)))
         return embedding.PassKeyMapper(uniq), len(uniq), host_rows
 
@@ -143,10 +151,12 @@ class BoxPSEngine:
         else:
             self._pulled_stats = None
         with self.timers("build_device"):
+            t0 = time.monotonic()
             sharding = (self.topology.table_sharding()
                         if self.topology is not None else None)
             ws = embedding.build_working_set(
                 host_rows, self.config.embedding_dim, sharding=sharding)
+            intervals.record("upload", t0, time.monotonic())
             if self._pulled_stats is not None:
                 # exact per-pass counter accumulators (small magnitudes
                 # stay exact in f32); merged into the f64 host stats at
@@ -173,6 +183,8 @@ class BoxPSEngine:
         # pboxlint: disable-next=PB102 -- lifecycle flag, coordinator-only
         self._feeding = False
         uniq = self._dedup_agent_keys()
+        flight.record("pass_feed_end", pass_id=self.pass_id + 1,
+                      keys=len(uniq), asynchronous=async_build)
         if not async_build:
             assert self._build_thread is None and self._next is None, \
                 "a preloaded pass is pending adoption (begin_pass) — " \
@@ -225,6 +237,8 @@ class BoxPSEngine:
             assert self.ws is not None, \
                 "end_feed_pass must run before begin_pass"
             self.pass_id += 1
+            flight.record("pass_begin", pass_id=self.pass_id,
+                          keys=self.num_keys)
 
     def _refresh_stale_rows(self) -> None:
         """An async-built working set pulled host rows while the previous
@@ -294,8 +308,9 @@ class BoxPSEngine:
             try:
                 t0 = time.monotonic()
                 self.table.bulk_write(self.mapper.sorted_keys, soa)
-                stat_add("ps.engine.end_pass_write_s",
-                         time.monotonic() - t0)
+                t1 = time.monotonic()
+                intervals.record("write", t0, t1)
+                stat_add("ps.engine.end_pass_write_s", t1 - t0)
             except Exception:
                 # keep _pulled_stats/ws/mapper: a re-driven end_pass must
                 # rebuild the IDENTICAL soa (clearing the stats first used
@@ -305,6 +320,17 @@ class BoxPSEngine:
             self._pulled_stats = None
         self.ws = None
         self._last_written = np.asarray(self.mapper.sorted_keys)
+        # feed-gap attribution over THIS pass's window (begin_feed_pass →
+        # write-back done), overlap-aware: surfaces in /statz, the
+        # per-pass report, and the BENCH result JSON (ROADMAP item 2)
+        m0 = getattr(self, "_pass_m0", None)
+        if m0 is not None:
+            rep = intervals.report(since=m0)
+            self._pass_feed_report = rep
+            stat_set("feed.device_busy_frac", rep["device_busy_frac"])
+            stat_set("feed.feed_gap_ratio", rep["feed_gap_ratio"])
+        flight.record("pass_end", pass_id=self.pass_id,
+                      keys=self.num_keys)
         if flags.get_flags("obs_pass_report"):
             print(self.pass_report(), flush=True)
         if need_save_delta and delta_path:
@@ -321,17 +347,24 @@ class BoxPSEngine:
         self.ws = embedding.quantize_working_set(self.ws, qb, scale)
 
     # -- persistence ---------------------------------------------------------
+    def _save(self, path: str, mode: str) -> int:
+        rows = self.table.save(path, mode=mode)
+        flight.record("checkpoint_save", mode=mode, path=path, rows=rows)
+        return rows
+
     def save_base(self, path: str) -> int:
-        return self.table.save(path, mode="base")
+        return self._save(path, "base")
 
     def save_delta(self, path: str) -> int:
-        return self.table.save(path, mode="delta")
+        return self._save(path, "delta")
 
     def save_checkpoint(self, path: str) -> int:
-        return self.table.save(path, mode="all")
+        return self._save(path, "all")
 
     def load(self, path: str) -> int:
-        return self.table.load(path)
+        rows = self.table.load(path)
+        flight.record("checkpoint_load", path=path, rows=rows)
+        return rows
 
     def shrink(self) -> int:
         return self.table.shrink()
@@ -396,4 +429,20 @@ class BoxPSEngine:
         faults_n = sum(delta(k) for k in cur if k.startswith("ps.fault."))
         if faults_n:
             lines.append(f"  injected_faults={int(faults_n)}")
+        rep = getattr(self, "_pass_feed_report", None)
+        if rep:
+            # interval-accounted utilization (utils/intervals.py): how
+            # much of the pass wall the device actually had work, and
+            # how much host feed time hid behind it
+            lines.append(
+                f"  feed gap: wall={rep['wall_s']:.3f}s "
+                f"device_busy={rep['device_busy_s']:.3f}s "
+                f"device_busy_frac={rep['device_busy_frac']:.2f} "
+                f"feed_gap_ratio={rep['feed_gap_ratio']:.2f}")
+            lines.append(
+                f"  host busy: pull={rep['pull_busy_s']:.3f}s "
+                f"pack={rep['pack_busy_s']:.3f}s "
+                f"upload={rep['upload_busy_s']:.3f}s "
+                f"write={rep['write_busy_s']:.3f}s "
+                f"overlapped_with_device={rep['overlap_s']:.3f}s")
         return "\n".join(lines)

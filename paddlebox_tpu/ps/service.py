@@ -70,7 +70,7 @@ import numpy as np
 from paddlebox_tpu import flags
 from paddlebox_tpu.ps import faults, wire
 from paddlebox_tpu.ps.host_table import ShardedHostTable
-from paddlebox_tpu.utils import trace
+from paddlebox_tpu.utils import flight, trace
 from paddlebox_tpu.utils.backoff import Backoff
 from paddlebox_tpu.utils.monitor import (stat_add, stat_max, stat_observe,
                                          stat_snapshot)
@@ -197,6 +197,7 @@ class _DedupWindow:
                     return None
                 if entry[0]:                        # done → replay
                     stat_add("ps.server.dedup_hit")
+                    flight.record("dedup_hit", rid=rid)
                     return entry[1]
                 # original still executing on another handler thread
                 stat_add("ps.server.dedup_wait")
@@ -219,9 +220,12 @@ class _DedupWindow:
                 # for before it wakes
                 entries.move_to_end(rid)
                 done = [r for r, e in entries.items() if e[0]]
-                for r in done[:max(0, len(done) - self.cap)]:
+                evicted = done[:max(0, len(done) - self.cap)]
+                for r in evicted:
                     del entries[r]
                     stat_add("ps.server.dedup_evict")
+                if evicted:
+                    flight.record("dedup_evict", n=len(evicted))
             self._cv.notify_all()
 
     def drop(self, rid: str) -> None:
@@ -776,6 +780,7 @@ class _PipelineRun:
             self._cv.notify_all()
         if self.gave_up:
             stat_add("ps.client.give_up")
+            flight.record("verb_give_up", site="chunk_requeue")
 
     def abort(self, err: BaseException) -> None:
         """A non-retryable failure (server-side verb error, oversized
@@ -1010,10 +1015,14 @@ class PSClient:
                 self._checkin(stream)
                 attempt += 1
                 stat_add("ps.client.retry")
+                flight.record("verb_retry", cmd=req.get("cmd"),
+                              attempt=attempt, error=type(e).__name__)
                 exhausted = (self.retries is not None
                              and attempt >= self.retries)
                 if not retry or exhausted or not bo.sleep(attempt):
                     stat_add("ps.client.give_up")
+                    flight.record("verb_give_up", cmd=req.get("cmd"),
+                                  attempt=attempt)
                     raise ConnectionError(
                         f"ps call {req.get('cmd')!r} failed after "
                         f"{attempt} attempt(s): {e}") from e
@@ -1087,11 +1096,15 @@ class PSClient:
             except (ConnectionError, OSError) as e:
                 attempt += 1
                 stat_add("ps.client.retry")
+                flight.record("verb_retry", site="pump_connect",
+                              attempt=attempt, error=type(e).__name__)
                 run.note_net_error(e)
                 exhausted = (self.retries is not None
                              and attempt >= self.retries)
                 if exhausted or not bo.sleep(attempt):
                     stat_add("ps.client.give_up")
+                    flight.record("verb_give_up", site="pump_connect",
+                                  attempt=attempt)
                     return          # this stream gives up; others continue
                 continue
 
@@ -1208,6 +1221,8 @@ class PSClient:
             if run._stopped() or err is None:
                 return
             stat_add("ps.client.stream_reconnect")
+            flight.record("stream_reconnect", error=type(err).__name__,
+                          requeued=len(leftover))
             run.note_net_error(err)
             if state["progress"]:
                 attempt = 0
@@ -1216,6 +1231,8 @@ class PSClient:
             stat_add("ps.client.retry")
             if not bo.sleep(attempt):
                 stat_add("ps.client.give_up")
+                flight.record("verb_give_up", site="pump_reconnect",
+                              attempt=attempt)
                 return
 
     # -- verbs (table=None → the default table) -----------------------------

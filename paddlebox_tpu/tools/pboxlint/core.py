@@ -114,11 +114,12 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 
 def ALL_CHECKERS():
     # local import: checker modules import core for helpers
-    from paddlebox_tpu.tools.pboxlint import (flags_hygiene, lifecycle,
-                                              locks, metric_names, purity,
-                                              retries)
+    from paddlebox_tpu.tools.pboxlint import (flags_hygiene, flight_events,
+                                              lifecycle, locks,
+                                              metric_names, purity, retries)
     return (locks.check, flags_hygiene.check, metric_names.check,
-            purity.check, lifecycle.check, retries.check)
+            flight_events.check, purity.check, lifecycle.check,
+            retries.check)
 
 
 def lint_modules(modules: Sequence[Module]) -> List[Finding]:

@@ -37,7 +37,7 @@ from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm
 from paddlebox_tpu.parallel.topology import HybridTopology
 from paddlebox_tpu.ps import embedding, optimizer as sparse_opt
 from paddlebox_tpu.ps.pass_manager import BoxPSEngine
-from paddlebox_tpu.utils import trace
+from paddlebox_tpu.utils import intervals, trace
 from paddlebox_tpu.utils.channel import Channel, ChannelClosed
 from paddlebox_tpu.utils.monitor import stat_observe
 from paddlebox_tpu.utils.timer import TimerRegistry
@@ -828,10 +828,15 @@ class SparseTrainer:
         try:
             for i in range(feed.n_batches):
                 t_step = time.perf_counter()
+                m_step = time.monotonic()
                 with self.timers("step"):
                     out = self._packed_step_fn(ws, params, opt_state,
                                                auc_state, np.int32(i),
                                                feed.data, plans)
+                # device-busy window for feed-gap attribution (dispatch
+                # window; on async backends the device may still be
+                # executing past it — a lower bound, not an overcount)
+                intervals.record("device", m_step, time.monotonic())
                 # per-batch dispatch latency distribution (the loss
                 # readback below is the sync point, so this is dispatch
                 # cost, not device step time)
@@ -983,7 +988,9 @@ class SparseTrainer:
 
         def pack_one(block):
             t0 = time.perf_counter()
+            m0 = time.monotonic()
             b = self.packer.pack(block, key_mapper=mapper)
+            intervals.record("pack", m0, time.monotonic())
             self.timers.add("pack", time.perf_counter() - t0)
             return b
 
@@ -1018,9 +1025,11 @@ class SparseTrainer:
                 except ChannelClosed:
                     break
                 dev = self._put_batch(batch)
+                m_step = time.monotonic()
                 with self.timers("step"):
                     out = self._step_fn(ws, params, opt_state, auc_state,
                                         *dev)
+                intervals.record("device", m_step, time.monotonic())
                 if self.async_dense is not None:
                     (ws, params, opt_state, auc_state, loss, preds,
                      d_params) = out

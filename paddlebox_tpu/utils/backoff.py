@@ -17,6 +17,8 @@ import random
 import time
 from typing import Optional
 
+from paddlebox_tpu.utils import flight
+
 
 class Backoff:
     """One retry episode: ``delay(attempt)`` is the pure policy math
@@ -60,7 +62,10 @@ class Backoff:
         rem = self.remaining()
         if rem is not None:
             if rem <= 0:
+                flight.record("backoff_exhausted", attempt=attempt)
                 return False
             d = min(d, rem)
+        flight.record("backoff_sleep", attempt=attempt,
+                      delay_s=round(d, 4))
         time.sleep(d)
         return True

@@ -70,6 +70,11 @@ class Histogram:
 
     def observe(self, value: float) -> None:
         v = float(value)
+        if not math.isfinite(v):
+            # a single nan would poison `total` (and every later .sum /
+            # mean) while leaving vmin/vmax untouched — drop it here and
+            # let the registry count the drop
+            return
         self.count += 1
         self.total += v
         if v < self.vmin:
@@ -105,6 +110,40 @@ class Histogram:
             "p99": self.percentile(99),
             "max": self.vmax if self.count else 0.0,
         }
+
+    def raw(self) -> Dict:
+        """Mergeable wire form: sparse bucket counts + exact
+        count/sum/min/max.  ``/statz?raw=1`` ships this so the
+        supervisor can merge histograms BUCKET-WISE across workers and
+        recompute job-wide percentiles (max-of-per-worker-percentiles is
+        statistically wrong — see obs_server.merge_snapshots)."""
+        return {
+            "b": {str(i): c for i, c in enumerate(self.counts) if c},
+            "count": self.count,
+            "sum": self.total,
+            "min": self.vmin if self.count else 0.0,
+            "max": self.vmax if self.count else 0.0,
+        }
+
+    @classmethod
+    def from_raw(cls, raws: List[Dict]) -> "Histogram":
+        """Rebuild one histogram from the bucket-wise sum of many
+        ``raw()`` dicts (identical fixed bucket geometry on every
+        worker makes this exact up to bucket resolution)."""
+        h = cls()
+        nb = len(h.counts)
+        for r in raws:
+            for i, c in (r.get("b") or {}).items():
+                idx = int(i)
+                if 0 <= idx < nb:
+                    h.counts[idx] += int(c)
+            n = int(r.get("count", 0))
+            h.count += n
+            h.total += float(r.get("sum", 0.0))
+            if n > 0:
+                h.vmin = min(h.vmin, float(r.get("min", math.inf)))
+                h.vmax = max(h.vmax, float(r.get("max", -math.inf)))
+        return h
 
 
 def _prefix_match(key: str, prefix: str) -> bool:
@@ -151,8 +190,14 @@ class StatRegistry:
     def observe(self, name: str, value: float) -> None:
         """Record one sample into the named histogram (created on first
         observe; bounded memory per name — see lint rule PB204 for why
-        the NAME set must be bounded too)."""
+        the NAME set must be bounded too).  Non-finite samples are
+        dropped (they would poison ``sum``) and counted under
+        ``obs.non_finite_dropped``."""
         with self._lock:
+            if not math.isfinite(float(value)):
+                self._stats["obs.non_finite_dropped"] = \
+                    self._stats.get("obs.non_finite_dropped", 0.0) + 1.0
+                return
             h = self._hists.get(name)
             if h is None:
                 h = self._hists[name] = Histogram()
@@ -183,6 +228,13 @@ class StatRegistry:
         with self._lock:
             names = [n for n in self._hists if _prefix_match(n, prefix)]
             return {n: self._hists[n].summary() for n in names}
+
+    def hist_raw(self, prefix: str = "") -> Dict[str, Dict]:
+        """Raw (mergeable) histogram exports keyed by name — the
+        ``/statz?raw=1`` payload."""
+        with self._lock:
+            names = [n for n in self._hists if _prefix_match(n, prefix)]
+            return {n: self._hists[n].raw() for n in names}
 
     def counter_snapshot(self, prefix: str = "") -> Dict[str, float]:
         """Plain counters/gauges only (no histogram-derived keys)."""

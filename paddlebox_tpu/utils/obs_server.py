@@ -7,8 +7,14 @@ exposition, PAPERS.md):
   ``/statz``    the full flat JSON snapshot (counters + histogram
                 percentile keys) — the machine-merge surface the
                 launch.py supervisor scrapes into one job-wide view.
+                ``?raw=1`` adds ``_hist_raw`` (sparse bucket counts per
+                histogram) so the supervisor can merge bucket-wise.
   ``/tracez``   newest-N finished spans from the host tracer
                 (utils/trace.py), JSON.
+  ``/flightz``  newest-N flight-recorder events (utils/flight.py);
+                ``?n=`` and ``?kind=`` filter.
+  ``/debugz``   a full wedge-doctor bundle (utils/doctor.py): all-thread
+                stacks + flight ring + stat snapshot + workpool state.
 
 Off by default: ``FLAGS_obs_port`` = 0 starts nothing and no
 instrumentation site pays more than an is-None/flag check.  launch.py
@@ -20,15 +26,17 @@ the span tracer (``/tracez`` without a tracer would always be empty).
 from __future__ import annotations
 
 import json
+import math
 import re
 import threading
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from paddlebox_tpu import flags
-from paddlebox_tpu.utils import trace
-from paddlebox_tpu.utils.monitor import StatRegistry
+from paddlebox_tpu.utils import doctor, flight, trace
+from paddlebox_tpu.utils.monitor import Histogram, StatRegistry
 
 flags.define_flag(
     "obs_port", 0,
@@ -44,6 +52,18 @@ def _prom_name(name: str) -> str:
     return "pbox_" + _PROM_BAD.sub("_", name)
 
 
+def _prom_val(v: float) -> str:
+    """Prometheus sample value: non-finite gauges render as the
+    exposition-format spellings ``+Inf``/``-Inf``/``NaN`` (Python's
+    ``repr`` gives ``inf``/``nan``, which scrapers reject)."""
+    f = float(v)
+    if math.isfinite(f):
+        return repr(f)
+    if math.isnan(f):
+        return "NaN"
+    return "+Inf" if f > 0 else "-Inf"
+
+
 def render_prometheus() -> str:
     """Prometheus text exposition (version 0.0.4) of the registry:
     plain stats as gauges, histograms as summaries."""
@@ -52,19 +72,33 @@ def render_prometheus() -> str:
     for name, val in sorted(reg.counter_snapshot().items()):
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} gauge")
-        lines.append(f"{pn} {val!r}")
+        lines.append(f"{pn} {_prom_val(val)}")
     for name, summ in sorted(reg.hist_snapshot().items()):
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} summary")
         for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
-            lines.append(f'{pn}{{quantile="{q}"}} {summ[key]!r}')
-        lines.append(f"{pn}_sum {summ['sum']!r}")
+            lines.append(f'{pn}{{quantile="{q}"}} {_prom_val(summ[key])}')
+        lines.append(f"{pn}_sum {_prom_val(summ['sum'])}")
         lines.append(f"{pn}_count {int(summ['count'])}")
     return "\n".join(lines) + "\n"
 
 
-def render_statz() -> str:
-    return json.dumps(StatRegistry.instance().snapshot(), sort_keys=True)
+# reserved key carrying raw histogram buckets in a /statz?raw=1 snapshot
+HIST_RAW_KEY = "_hist_raw"
+
+
+def render_statz(raw: bool = False) -> str:
+    """The flat JSON snapshot.  Non-finite gauges are OMITTED — bare
+    ``Infinity``/``NaN`` tokens are invalid JSON and would break every
+    strict consumer of the scrape.  ``raw=True`` adds ``_hist_raw``
+    (sparse bucket counts per histogram) for bucket-wise supervisor
+    merging."""
+    reg = StatRegistry.instance()
+    out: Dict = {k: v for k, v in reg.snapshot().items()
+                 if math.isfinite(v)}
+    if raw:
+        out[HIST_RAW_KEY] = reg.hist_raw()
+    return json.dumps(out, sort_keys=True)
 
 
 def render_tracez(limit: int = 256) -> str:
@@ -73,23 +107,43 @@ def render_tracez(limit: int = 256) -> str:
                        "spans": spans})
 
 
+def render_flightz(n: int = 256, kind: Optional[str] = None) -> str:
+    ring = flight.ring()
+    return json.dumps({
+        "enabled": ring is not None,
+        "capacity": ring.capacity if ring is not None else 0,
+        "counts": ring.counts() if ring is not None else {},
+        "events": flight.events(n=n, kind=kind),
+    }, default=str)
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):        # no stderr spam per scrape
         pass
 
     def do_GET(self):
-        path = self.path.split("?", 1)[0]
+        path, _, qs = self.path.partition("?")
+        q = urllib.parse.parse_qs(qs)
         try:
             if path == "/metrics":
                 body = render_prometheus()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/statz":
-                body, ctype = render_statz(), "application/json"
+                raw = q.get("raw", ["0"])[0] not in ("", "0")
+                body, ctype = render_statz(raw=raw), "application/json"
             elif path == "/tracez":
                 body, ctype = render_tracez(), "application/json"
+            elif path == "/flightz":
+                n = int(q.get("n", ["256"])[0])
+                kind = q.get("kind", [None])[0]
+                body, ctype = render_flightz(n=n, kind=kind), \
+                    "application/json"
+            elif path == "/debugz":
+                body, ctype = doctor.render_debugz(), "application/json"
             else:
                 self.send_error(404, "unknown path (want /metrics, "
-                                     "/statz, /tracez)")
+                                     "/statz, /tracez, /flightz, "
+                                     "/debugz)")
                 return
         except Exception as e:  # noqa: BLE001 — a scrape must never kill
             self.send_error(500, repr(e))
@@ -167,23 +221,51 @@ def scrape(port: int, path: str = "/statz", host: str = "127.0.0.1",
 
 
 _MERGE_MAX_SUFFIXES = (".max", ".p50", ".p95", ".p99", "hwm")
+_PCT_SUFFIXES = (".p50", ".p95", ".p99")
+_PCT_QS = ((50, ".p50"), (95, ".p95"), (99, ".p99"))
 
 
 def merge_snapshots(snaps: List[Dict[str, float]]) -> Dict[str, float]:
     """Fold per-worker /statz snapshots into one job-wide view: counters
-    and sums ADD across workers; high-water marks and percentile keys
-    take the worst (max) worker — a job is as slow as its slowest
-    shard."""
+    and sums ADD across workers; high-water marks take the worst (max)
+    worker — a job is as slow as its slowest shard.
+
+    Percentiles: taking the max of per-worker ``.p50/.p95/.p99`` is
+    statistically wrong (the max of medians is not the median of the
+    union, and tail percentiles can be badly skewed by one low-count
+    worker).  When a snapshot carries ``_hist_raw`` (a ``/statz?raw=1``
+    scrape), its histograms are merged BUCKET-WISE across workers and
+    job-wide percentiles are recomputed exactly (up to bucket
+    resolution).  Workers that predate raw export still fold in via the
+    old max-of-percentiles fallback, so merged tails never understate."""
     out: Dict[str, float] = {}
+    raws: Dict[str, List[Dict]] = {}
     for snap in snaps:
         if not snap:
             continue
+        hr = snap.get(HIST_RAW_KEY)
+        hr = hr if isinstance(hr, dict) else {}
+        for name, r in hr.items():
+            if isinstance(r, dict):
+                raws.setdefault(name, []).append(r)
         for k, v in snap.items():
-            if not isinstance(v, (int, float)):
+            if k == HIST_RAW_KEY or not isinstance(v, (int, float)):
                 continue
             if k.endswith(_MERGE_MAX_SUFFIXES):
+                # this worker's percentile keys are recomputed from its
+                # raw buckets below — don't let its per-worker
+                # percentile leak into the max fallback
+                if k.endswith(_PCT_SUFFIXES) and \
+                        k.rsplit(".", 1)[0] in hr:
+                    continue
                 if v > out.get(k, float("-inf")):
                     out[k] = v
             else:
                 out[k] = out.get(k, 0.0) + v
+    for name, rlist in raws.items():
+        h = Histogram.from_raw(rlist)
+        for q, suf in _PCT_QS:
+            k = name + suf
+            v = h.percentile(q)
+            out[k] = max(out[k], v) if k in out else v
     return out

@@ -37,6 +37,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional, Sequence, TypeVar
 
 from paddlebox_tpu import flags
+from paddlebox_tpu.utils import flight
 from paddlebox_tpu.utils.monitor import (stat_add, stat_max, stat_observe,
                                          stat_set)
 
@@ -70,6 +71,7 @@ class WorkPool:
         self._lock = threading.Lock()
         self._queued = 0        # submitted, not yet picked up
         self._active = 0        # running right now
+        self._sat_hwm = 0       # deepest saturated queue flight-recorded
         self._ex: Optional[ThreadPoolExecutor] = None
         if self.threads > 1:
             self._ex = ThreadPoolExecutor(
@@ -110,8 +112,16 @@ class WorkPool:
         with self._lock:
             self._queued += n
             depth = self._queued + self._active
+            # flight-record saturation only on a NEW high-water mark so
+            # a persistently deep queue emits O(log) events, not O(maps)
+            saturated_hwm = depth > self.threads and depth > self._sat_hwm
+            if saturated_hwm:
+                self._sat_hwm = depth
         stat_observe(f"ps.pool.{self.kind}.queue_depth", float(depth))
         stat_max(f"ps.pool.{self.kind}.queue_depth_hwm", float(depth))
+        if saturated_hwm:
+            flight.record("pool_saturated", pool=self.kind, depth=depth,
+                          threads=self.threads)
         futs = []
         try:
             for it in items:
@@ -126,6 +136,14 @@ class WorkPool:
             head = [f.result() for f in futs]
             return head + [fn(it) for it in items[len(futs):]]
         return [f.result() for f in futs]
+
+    def state(self) -> dict:
+        """Queue/occupancy snapshot for the wedge doctor
+        (utils/doctor.py): is a hang waiting ON the pool or IN it?"""
+        with self._lock:
+            return {"kind": self.kind, "threads": self.threads,
+                    "queued": self._queued, "active": self._active,
+                    "saturated_hwm": self._sat_hwm}
 
     def shutdown(self) -> None:
         if self._ex is not None:
@@ -150,3 +168,12 @@ def table_pool() -> WorkPool:
             if old is not None:
                 old.shutdown()
         return _POOL
+
+
+def pool_state() -> Optional[dict]:
+    """State of the process pool WITHOUT creating it (doctor scrapes
+    must not side-effect a pool into existence); None when no pool has
+    been built yet."""
+    with _POOL_LOCK:
+        pool = _POOL
+    return pool.state() if pool is not None else None

@@ -209,6 +209,122 @@ def test_supervisor_stops_on_repeated_deterministic_failure():
     assert line["supervisor_attempts"] <= 2      # stopped early, not 20
 
 
+# -- wedge postmortems + feed-gap + compare mode -----------------------------
+
+def test_watchdog_writes_postmortem_before_error_line(bench, monkeypatch,
+                                                      tmp_path):
+    """Phase-budget expiry must leave a stack bundle on disk BEFORE the
+    error line, and the line must carry its path."""
+    from paddlebox_tpu import flags
+    from paddlebox_tpu.utils import doctor  # registers obs_postmortem_dir
+    assert doctor is not None
+    flags.set_flags({"obs_postmortem_dir": str(tmp_path)})
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    def fake_exit(code):
+        raise SystemExit
+
+    monkeypatch.setattr(os, "_exit", fake_exit)
+    try:
+        bench.record(device_step=1000.0)
+        bench.set_phase("full:compile", budget_s=-1)
+        with pytest.raises(SystemExit):
+            bench._watchdog()
+    finally:
+        flags.set_flags({"obs_postmortem_dir": ""})
+    line = _last_json(out)
+    pm = line["postmortem"]
+    assert pm and os.path.exists(pm), line
+    bundle = json.load(open(pm))
+    assert "full:compile" in bundle["reason"]
+    assert any(t["name"] == "MainThread" for t in bundle["threads"])
+    assert isinstance(bundle["stats"], dict)
+
+
+def test_wedged_child_ships_postmortem_bundle(tmp_path):
+    """The acceptance scenario: a simulated post-backend wedge.  The
+    child's watchdog writes a postmortem naming the stuck phase and the
+    stuck thread, and the supervisor's attempt_log carries its path."""
+    pm_dir = str(tmp_path / "pm")
+    line, _err = _run_bench({
+        "BENCH_FORCE_CPU": "1",
+        "BENCH_TEST_WEDGE_PHASE": "1",
+        "BENCH_TEST_WEDGE_BUDGET_S": "3",
+        "FLAGS_obs_postmortem_dir": pm_dir,
+        "BENCH_BACKEND_ATTEMPT_S": "60",
+        "BENCH_TIMEOUT_S": "150"}, timeout=200)
+    assert "wedge-sim" in line.get("error", ""), line
+    log = line.get("attempt_log")
+    assert log, line
+    pm = log[0].get("postmortem")
+    assert pm and os.path.exists(pm), log
+    bundle = json.load(open(pm))
+    assert "wedge-sim" in bundle["reason"]
+    sleeper = [t for t in bundle["threads"] if t["name"] == "wedge-sleeper"]
+    assert sleeper, [t["name"] for t in bundle["threads"]]
+    assert any("sleep" in fr for fr in sleeper[0]["stack"])
+    # last-N flight events rode along, including the phase trail
+    phases = [e for e in bundle["flight"] if e["kind"] == "bench_phase"]
+    assert any(e["phase"] == "wedge-sim" for e in phases)
+    assert isinstance(bundle["stats"], dict)
+
+
+def _result_file(path, value, gap, obs=None, wrapper=False):
+    line = {"metric": "paddlebox_steady_examples_per_sec", "value": value,
+            "unit": "examples/s", "vs_baseline": round(value / 1e6, 4),
+            "final": True, "feed_gap_ratio": gap,
+            "obs_stats": obs or {}}
+    obj = {"n": 3, "cmd": "python bench.py", "rc": 0, "tail": "",
+           "parsed": line} if wrapper else line
+    path.write_text(json.dumps(obj))
+    return str(path)
+
+
+def test_compare_flags_throughput_regression(bench, monkeypatch, tmp_path):
+    old = _result_file(tmp_path / "old.json", 1000.0, 2.0,
+                       obs={"ps.client.retry": 1.0})
+    new = _result_file(tmp_path / "new.json", 800.0, 2.0,
+                       obs={"ps.client.retry": 9.0}, wrapper=True)
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    rc = bench.compare(old, new, threshold=0.05)
+    assert rc == 1
+    rep = json.loads(out.getvalue())
+    assert rep["ok"] is False
+    assert any("value" in r for r in rep["regressions"])
+    assert rep["value"]["delta_frac"] == pytest.approx(-0.2)
+    # obs movers beyond threshold are surfaced (informational)
+    assert "ps.client.retry" in rep["obs_deltas"]
+
+
+def test_compare_flags_feed_gap_regression_and_threshold(bench, monkeypatch,
+                                                         tmp_path):
+    old = _result_file(tmp_path / "old.json", 1000.0, 2.0)
+    new = _result_file(tmp_path / "new.json", 1010.0, 3.0)
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    assert bench.compare(old, new, threshold=0.05) == 1   # gap +50%
+    assert bench.compare(old, new, threshold=0.6) == 0    # within 60%
+
+
+def test_compare_cli_dispatch(tmp_path):
+    import subprocess
+    old = _result_file(tmp_path / "old.json", 1000.0, 2.0)
+    new = _result_file(tmp_path / "new.json", 990.0, 2.1)
+    proc = subprocess.run(
+        [sys.executable, BENCH_PATH, "--compare", old, new,
+         "--threshold=0.1"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["ok"] is True
+    bad = subprocess.run(
+        [sys.executable, BENCH_PATH, "--compare", old],
+        capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 2                    # usage error
+
+
 def test_supervisor_smoke_line_never_shadows_dead_full_run():
     """A clean MID-RUN smoke line must not pass for the round result when
     the child dies before the full run: the final line keeps the smoke

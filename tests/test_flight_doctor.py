@@ -1,0 +1,340 @@
+"""Flight recorder, wedge doctor, and feed-gap attribution: ring
+semantics (wrap, seq, kind filter, flag-off), interval union/overlap
+math, postmortem bundles (dump_state, SIGUSR1 round trip), the
+/flightz + /debugz + /statz?raw=1 endpoints, bucket-wise percentile
+merging across workers, non-finite sanitization, and a concurrent
+multi-client scrape stress over every endpoint under live traffic."""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import flags
+from paddlebox_tpu.utils import doctor, flight, intervals, obs_server
+from paddlebox_tpu.utils.monitor import (Histogram, StatRegistry, stat_add,
+                                         stat_get, stat_observe, stat_set,
+                                         stat_snapshot)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    StatRegistry.instance().reset()
+    flags.set_flags({"obs_flight_ring": 2048, "obs_postmortem_dir": ""})
+    flight.reconfigure()
+    intervals.clear()
+    yield
+    StatRegistry.instance().reset()
+    flags.set_flags({"obs_flight_ring": 2048, "obs_postmortem_dir": ""})
+    flight.reconfigure()
+    intervals.clear()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as r:
+        return r.read().decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# flight ring
+# ---------------------------------------------------------------------------
+def test_flight_ring_wrap_filter_and_seq():
+    flags.set_flags({"obs_flight_ring": 8})
+    flight.reconfigure()
+    for i in range(20):
+        flight.record("verb_retry" if i % 2 else "pass_begin", i=i)
+    ring = flight.ring()
+    assert ring.capacity == 8
+    evs = flight.events()
+    assert len(evs) == 8                         # bounded retention
+    # newest-first, and seq survives the wrap (gap detection)
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs, reverse=True)
+    assert seqs[0] == 20
+    assert all(e["thread"] == "MainThread" for e in evs)
+    # kind filter + n limit
+    retries = flight.events(kind="verb_retry")
+    assert retries and all(e["kind"] == "verb_retry" for e in retries)
+    assert len(flight.events(n=3)) == 3
+    counts = ring.counts()
+    assert sum(counts.values()) == 8
+    assert set(counts) == {"verb_retry", "pass_begin"}
+
+
+def test_flight_disabled_by_flag_zero():
+    flags.set_flags({"obs_flight_ring": 0})
+    flight.reconfigure()
+    assert flight.ring() is None
+    flight.record("pass_begin")                  # must be a free no-op
+    assert flight.events() == []
+
+
+def test_library_sites_record_flight_events():
+    """The wired producers actually emit: a backoff sleep and a workpool
+    map both land in the ring with their typed fields."""
+    from paddlebox_tpu.utils.backoff import Backoff
+    bo = Backoff(base=0.001, cap=0.002, deadline=30)
+    bo.sleep(1)
+    evs = flight.events(kind="backoff_sleep")
+    assert evs and evs[0]["attempt"] == 1 and evs[0]["delay_s"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# interval accounting
+# ---------------------------------------------------------------------------
+def test_union_seconds_coalesces_and_clips():
+    iv = [(0.0, 1.0), (0.5, 2.0), (3.0, 4.0)]
+    assert intervals.union_seconds(iv) == pytest.approx(3.0)
+    assert intervals.union_seconds(iv, since=1.5) == pytest.approx(1.5)
+    assert intervals.union_seconds(iv, until=0.75) == pytest.approx(0.75)
+    assert intervals.union_seconds([]) == 0.0
+
+
+def test_report_overlap_math():
+    r = intervals.IntervalRecorder()
+    r.record("device", 0.0, 1.0)
+    r.record("pull", 0.5, 1.5)
+    r.record("pack", 1.2, 1.8)
+    r.record("bogus", 0.0, 9.0)                  # unknown kind: ignored
+    r.record("pull", 5.0, 4.0)                   # t1 <= t0: ignored
+    rep = r.report(since=0.0, until=2.0)
+    assert rep["wall_s"] == pytest.approx(2.0)
+    assert rep["device_busy_s"] == pytest.approx(1.0)
+    assert rep["pull_busy_s"] == pytest.approx(1.0)
+    assert rep["pack_busy_s"] == pytest.approx(0.6)
+    # host union [0.5, 1.8]; overlap with device [0.5, 1.0]
+    assert rep["host_busy_s"] == pytest.approx(1.3)
+    assert rep["overlap_s"] == pytest.approx(0.5)
+    assert rep["device_busy_frac"] == pytest.approx(0.5)
+    assert rep["feed_gap_ratio"] == pytest.approx(2.0)
+
+
+def test_interval_record_feeds_cumulative_stats():
+    intervals.record("pack", 10.0, 10.5)
+    intervals.record("pack", 11.0, 11.25)
+    assert stat_get("feed.pack.busy_s") == pytest.approx(0.75)
+
+
+def test_pass_manager_reports_feed_gap():
+    """One engine pass computes device_busy_frac / feed_gap_ratio, sets
+    the gauges, and prints them in the per-pass report."""
+    from paddlebox_tpu.config import EmbeddingTableConfig
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+    engine = BoxPSEngine(EmbeddingTableConfig(embedding_dim=4, shard_num=4))
+    engine.begin_feed_pass()
+    engine.add_keys(np.arange(1, 100, dtype=np.uint64))
+    engine.end_feed_pass()
+    engine.begin_pass()
+    # a device-step window inside the pass, as the trainer would record
+    m = time.monotonic()
+    intervals.record("device", m, m + 0.01)
+    engine.end_pass()
+    rep = engine._pass_feed_report
+    assert rep["wall_s"] > 0
+    assert 0.0 < rep["device_busy_frac"] <= 1.0
+    assert rep["feed_gap_ratio"] >= 1.0
+    assert stat_get("feed.feed_gap_ratio") == pytest.approx(
+        rep["feed_gap_ratio"])
+    report = engine.pass_report()
+    assert "feed_gap_ratio=" in report and "overlapped_with_device=" in report
+    # pass/day lifecycle landed in the flight ring too
+    kinds = {e["kind"] for e in flight.events()}
+    assert {"pass_feed_begin", "pass_feed_end", "pass_begin",
+            "pass_end"} <= kinds
+
+
+# ---------------------------------------------------------------------------
+# wedge doctor
+# ---------------------------------------------------------------------------
+def test_dump_state_names_threads_and_carries_flight_tail():
+    park = threading.Event()
+    t = threading.Thread(target=park.wait, name="park-me", daemon=True)
+    t.start()
+    try:
+        flight.record("fault_injected", site="pull_sparse", action="drop")
+        stat_add("ps.client.retry", 3.0)
+        bundle = doctor.dump_state(reason="unit")
+        assert bundle["reason"] == "unit"
+        assert bundle["pid"] == os.getpid()
+        names = [th["name"] for th in bundle["threads"]]
+        assert names[0] == "MainThread"          # sorted first
+        assert "park-me" in names
+        parked = next(th for th in bundle["threads"]
+                      if th["name"] == "park-me")
+        assert any("wait" in fr for fr in parked["stack"])
+        assert any(e["kind"] == "fault_injected" for e in bundle["flight"])
+        assert bundle["stats"]["ps.client.retry"] == 3.0
+        assert "workpool" in bundle
+        json.dumps(bundle, default=str)          # JSON-able end to end
+    finally:
+        park.set()
+        t.join(timeout=5)
+
+
+def test_sigusr1_postmortem_round_trip(tmp_path):
+    flags.set_flags({"obs_postmortem_dir": str(tmp_path)})
+    assert doctor.install() is True
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        time.sleep(0.05)                         # handler runs on main
+        files = [f for f in os.listdir(tmp_path)
+                 if f.startswith("postmortem-")]
+        assert len(files) == 1
+        bundle = json.load(open(tmp_path / files[0]))
+        assert bundle["reason"] == "sigusr1"
+        assert any(th["name"] == "MainThread" for th in bundle["threads"])
+        # the write itself is a flight event (self-describing ring)
+        evs = flight.events(kind="postmortem_written")
+        assert evs and evs[0]["path"].endswith(files[0])
+    finally:
+        signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+
+
+# ---------------------------------------------------------------------------
+# endpoints
+# ---------------------------------------------------------------------------
+def test_flightz_debugz_statz_raw_endpoints():
+    flight.record("stream_reconnect", error="ConnectionError", requeued=2)
+    flight.record("verb_retry", cmd="pull_sparse", attempt=1)
+    for v in (0.01, 0.02):
+        stat_observe("rt.lat_s", v)
+    srv = obs_server.ObsServer(port=0)
+    try:
+        port = srv.addr[1]
+        fl = json.loads(_get(port, "/flightz"))
+        assert fl["enabled"] and fl["capacity"] == 2048
+        assert fl["counts"]["verb_retry"] == 1
+        assert fl["events"][0]["kind"] == "verb_retry"   # newest first
+        only = json.loads(_get(port, "/flightz?kind=stream_reconnect&n=1"))
+        assert [e["kind"] for e in only["events"]] == ["stream_reconnect"]
+        dbg = json.loads(_get(port, "/debugz"))
+        assert any(th["name"] == "MainThread" for th in dbg["threads"])
+        assert dbg["stats"]["rt.lat_s.count"] == 2.0
+        plain = json.loads(_get(port, "/statz"))
+        assert obs_server.HIST_RAW_KEY not in plain
+        raw = json.loads(_get(port, "/statz?raw=1"))
+        hr = raw[obs_server.HIST_RAW_KEY]
+        assert hr["rt.lat_s"]["count"] == 2
+        assert sum(hr["rt.lat_s"]["b"].values()) == 2
+        # 404 still names every path
+        try:
+            _get(port, "/nope")
+            assert False, "404 expected"
+        except urllib.error.HTTPError as e:
+            body = e.read().decode()
+            for p in ("/flightz", "/debugz", "/statz"):
+                assert p in body
+    finally:
+        srv.shutdown()
+
+
+def test_concurrent_multi_client_scrape_stress():
+    """8 clients hammer all four endpoints while live traffic mutates
+    the registry and the flight ring: every response must be complete
+    and parseable (ThreadingHTTPServer + short-critical-section locks)."""
+    srv = obs_server.ObsServer(port=0)
+    stop = threading.Event()
+    errors = []
+
+    def produce():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            stat_add("stress.counter")
+            stat_observe("stress.lat_s", 0.001 * (i % 7 + 1))
+            flight.record("verb_retry", cmd="pull_sparse", attempt=i % 5)
+            intervals.record("pack", i * 0.01, i * 0.01 + 0.005)
+
+    def scrape(cid):
+        try:
+            port = srv.addr[1]
+            for _ in range(6):
+                assert "pbox_stress_counter" in _get(port, "/metrics")
+                s = json.loads(_get(port, "/statz?raw=1"))
+                assert s["stress.counter"] >= 1
+                f = json.loads(_get(port, "/flightz?n=64"))
+                assert f["enabled"]
+                d = json.loads(_get(port, "/debugz"))
+                assert d["threads"]
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errors.append((cid, repr(e)))
+
+    producers = [threading.Thread(target=produce, daemon=True)
+                 for _ in range(2)]
+    clients = [threading.Thread(target=scrape, args=(i,), daemon=True)
+               for i in range(8)]
+    try:
+        for t in producers + clients:
+            t.start()
+        for t in clients:
+            t.join(timeout=60)
+    finally:
+        stop.set()
+        for t in producers:
+            t.join(timeout=5)
+        srv.shutdown()
+    assert not errors, errors
+
+
+# ---------------------------------------------------------------------------
+# bucket-wise percentile merge + non-finite sanitization
+# ---------------------------------------------------------------------------
+def test_merge_snapshots_bucketwise_is_exact():
+    rng = np.random.default_rng(7)
+    va = rng.lognormal(mean=-6.0, sigma=1.2, size=4000)
+    vb = rng.lognormal(mean=-4.0, sigma=0.8, size=1000)  # skewed worker
+    for v in va:
+        stat_observe("m.lat_s", v)
+    snap_a = json.loads(obs_server.render_statz(raw=True))
+    StatRegistry.instance().reset()
+    for v in vb:
+        stat_observe("m.lat_s", v)
+    snap_b = json.loads(obs_server.render_statz(raw=True))
+
+    merged = obs_server.merge_snapshots([snap_a, snap_b])
+    ref = Histogram()
+    for v in np.concatenate([va, vb]):
+        ref.observe(v)
+    for q in (50, 95, 99):
+        assert merged[f"m.lat_s.p{q}"] == pytest.approx(ref.percentile(q))
+    assert merged["m.lat_s.count"] == 5000.0
+    assert merged["m.lat_s.max"] == pytest.approx(max(va.max(), vb.max()))
+    assert obs_server.HIST_RAW_KEY not in merged
+    # max-of-medians would have been wrong: worker B's median dominates
+    naive = max(snap_a["m.lat_s.p50"], snap_b["m.lat_s.p50"])
+    assert merged["m.lat_s.p50"] < naive
+
+
+def test_merge_snapshots_raw_less_worker_falls_back_to_max():
+    for v in (0.01, 0.02, 0.03):
+        stat_observe("m.lat_s", v)
+    snap_a = json.loads(obs_server.render_statz(raw=True))
+    legacy = {"m.lat_s.p99": 9.0, "m.lat_s.count": 3.0,
+              "m.lat_s.max": 9.0}                 # predates raw export
+    merged = obs_server.merge_snapshots([snap_a, legacy])
+    assert merged["m.lat_s.p99"] == 9.0           # never understate tails
+    assert merged["m.lat_s.count"] == 6.0
+
+
+def test_non_finite_values_sanitized():
+    h = Histogram()
+    h.observe(float("nan"))
+    h.observe(float("inf"))
+    assert h.count == 0                           # dropped at observe()
+    stat_observe("x.lat_s", float("nan"))
+    assert stat_get("obs.non_finite_dropped") == 1.0
+    assert "x.lat_s.count" not in stat_snapshot("x.")
+    stat_set("g.bad", float("inf"))
+    stat_set("g.good", 1.5)
+    statz = json.loads(obs_server.render_statz())
+    assert "g.bad" not in statz                   # invalid JSON otherwise
+    assert statz["g.good"] == 1.5
+    prom = obs_server.render_prometheus()
+    assert "pbox_g_bad +Inf" in prom              # exposition spelling
+    json.loads(obs_server.render_statz(raw=True))  # stays strict JSON

@@ -272,6 +272,45 @@ def test_pb203_raw_flags_environ_read():
     assert codes(src, path="flags.py") == []
 
 
+def test_pb206_flight_kind_unbounded_fstring():
+    # the regression this rule exists for: an event kind minted from an
+    # unbounded value (a rid) — shreds the /flightz taxonomy
+    src = """
+    from paddlebox_tpu.utils import flight
+
+    def report(rid, cmd):
+        flight.record(f"retry_{rid}")
+        flight.record(f"retry_{cmd}")           # bounded field: fine
+        flight.record("verb_retry", rid=rid)    # rid in FIELDS: fine
+    """
+    assert codes(src) == ["PB206"]
+
+
+def test_pb206_literal_kind_must_be_lowercase_identifier():
+    src = """
+    from paddlebox_tpu.utils.flight import record as flight_record
+
+    def f():
+        flight_record("Pass.Begin")
+        flight_record("pass_begin")
+    """
+    assert codes(src) == ["PB206"]
+
+
+def test_pb206_unrelated_record_methods_out_of_scope():
+    # bench.py's record(**kw) partials and ring.record(...) methods must
+    # not trip the rule — sinks resolve through the flight import only
+    src = """
+    def record(**kw):
+        pass
+
+    def bench(self, rid):
+        record(kind=rid)
+        self._ring.record(f"x {rid}")
+    """
+    assert codes(src) == []
+
+
 # -- PB3xx JAX purity --------------------------------------------------------
 
 def test_pb301_host_sync_in_jitted_fn():
