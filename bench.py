@@ -311,6 +311,91 @@ def _profile_step_phases(trainer, feed, k=8):
     return out
 
 
+def _pass_cycle(tag, dataset, engine, trainer, n_passes):
+    """Same-run pipeline on/off comparison over WHOLE pass cycles.
+
+    The e2e phase measures the train loop on a prebuilt feed; this phase
+    measures full cycles (key feed -> dedup -> table pull -> pack ->
+    upload -> train -> write-back) over the same in-memory blocks, twice:
+    first with the pipeline OFF (pack_threads=1, serial pass loop), then
+    ON (pack WorkPool at min(4, cpu) + PassPrefetcher double buffer).
+    Same process, same compiled step — the ratio isolates exactly what
+    the pipelined feed engine buys."""
+    from paddlebox_tpu import flags
+    from paddlebox_tpu.data.prefetch import PassPrefetcher
+    from paddlebox_tpu.utils import intervals
+
+    n_examples = dataset.instance_num()
+    prev_threads = flags.get_flags("pass_pack_threads")
+
+    def feed_keys():
+        for blk in dataset.get_blocks():
+            engine.add_keys(blk.all_keys())
+        return dataset
+
+    def cycle(mode):
+        def heartbeat(p):
+            def hb(n):   # refresh phase budget: forward progress ≠ hang
+                set_phase(f"{tag}:pass-cycle:{mode}"
+                          f"[pass {p + 1}/{n_passes} batch {n}]", 300)
+            return hb
+
+        m0 = time.monotonic()
+        t0 = time.perf_counter()
+        if mode == "serial":
+            for p in range(n_passes):
+                set_phase(f"{tag}:pass-cycle:serial"
+                          f"[pass {p + 1}/{n_passes}]", 900)
+                engine.begin_feed_pass()
+                feed_keys()
+                engine.end_feed_pass()
+                engine.begin_pass()
+                feed = trainer.build_pass_feed(dataset)
+                trainer.train_pass(feed, progress=heartbeat(p))
+                engine.end_pass()
+        else:
+            pf = PassPrefetcher(engine, trainer)
+            try:
+                for _ in range(n_passes):
+                    pf.submit(feed_keys)
+                for p in range(n_passes):
+                    set_phase(f"{tag}:pass-cycle:pipelined"
+                              f"[pass {p + 1}/{n_passes}]", 900)
+                    feed = pf.next_pass()
+                    trainer.train_pass(feed, progress=heartbeat(p))
+                    pf.end_pass()
+            finally:
+                pf.close()
+        dt = time.perf_counter() - t0
+        rep = intervals.report(since=m0)
+        return {"wall_s": round(dt, 1),
+                "ex_s": round(n_passes * n_examples / dt, 1),
+                "feed_gap_ratio": round(rep.get("feed_gap_ratio", 0.0), 2),
+                "device_busy_frac":
+                    round(rep.get("device_busy_frac", 0.0), 4),
+                "hidden_s": {k: round(rep.get(f"{k}_hidden_s", 0.0), 3)
+                             for k in ("pull", "pack", "upload")}}
+
+    try:
+        # the pass opened for device-step/e2e is still live: write it
+        # back so both variants start from the same table state
+        if engine.ws is not None:
+            engine.end_pass()
+        flags.set_flags({"pass_pack_threads": 1})
+        serial = dict(cycle("serial"), pack_threads=1, prefetch=False)
+        pipe_threads = min(4, os.cpu_count() or 1)
+        flags.set_flags({"pass_pack_threads": pipe_threads})
+        pipelined = dict(cycle("pipelined"),
+                         pack_threads=pipe_threads, prefetch=True)
+    finally:
+        flags.set_flags({"pass_pack_threads": prev_threads})
+    speedup = pipelined["ex_s"] / max(serial["ex_s"], 1e-9)
+    return {"serial": serial, "pipelined": pipelined, "passes": n_passes,
+            "speedup": round(speedup, 2),
+            "feed_gap_improved":
+                pipelined["feed_gap_ratio"] < serial["feed_gap_ratio"]}
+
+
 def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     """One full bench at a given geometry.  Returns the results dict;
     records partials into _STATE as they are measured."""
@@ -480,7 +565,30 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
         except Exception as e:  # profile is diagnostic, never fatal
             trace(f"{tag}: step profile failed: {type(e).__name__}: {e}")
 
+    pass_cycle = {}
+    if tag == "full" and not legacy \
+            and os.environ.get("BENCH_PASS_CYCLE", "1") == "1":
+        set_phase(f"{tag}:pass-cycle", 900)
+        try:
+            pass_cycle = _pass_cycle(
+                tag, dataset, engine, trainer,
+                int(os.environ.get("BENCH_E2E_PASSES", 2)))
+            record(pass_cycle_speedup=pass_cycle["speedup"],
+                   pass_cycle_serial_eps=pass_cycle["serial"]["ex_s"],
+                   pass_cycle_pipelined_eps=pass_cycle["pipelined"]["ex_s"])
+            trace(f"{tag}: pass-cycle serial={pass_cycle['serial']['ex_s']:,.0f}"
+                  f" ex/s (gap {pass_cycle['serial']['feed_gap_ratio']:.2f})"
+                  f" pipelined={pass_cycle['pipelined']['ex_s']:,.0f} ex/s"
+                  f" (gap {pass_cycle['pipelined']['feed_gap_ratio']:.2f})"
+                  f" speedup={pass_cycle['speedup']:.2f}x")
+            if not pass_cycle["feed_gap_improved"]:
+                trace(f"{tag}: WARNING pass-cycle feed_gap_ratio did not "
+                      "improve with the pipeline on")
+        except Exception as e:  # comparison is diagnostic, never fatal
+            trace(f"{tag}: pass-cycle failed: {type(e).__name__}: {e}")
+
     return {"e2e": e2e_eps, "device_step": device_eps,
+            "pass_cycle": pass_cycle,
             "batches": int(stats["batches"]), "examples": int(n_examples),
             "auc": round(float(stats.get("auc", float("nan"))), 4),
             "compile_s": round(compile_s, 1), "pass_pack_s": round(pack_s, 1),
@@ -566,6 +674,7 @@ def run() -> None:
          trim_frac=full["trim_frac"],
          device_busy_frac=full["device_busy_frac"],
          feed_gap_ratio=full["feed_gap_ratio"],
+         pass_cycle=full["pass_cycle"],
          feed_intervals=full["feed_intervals"], timers=full["timers"],
          obs_stats=_obs_snapshot())
 
@@ -871,6 +980,15 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
         if gfrac > threshold:
             regressions.append(
                 f"feed_gap_ratio {go:.2f} -> {gn:.2f} ({gfrac:+.1%})")
+    so = num(old.get("pass_cycle") or {}, "speedup")
+    sn = num(new.get("pass_cycle") or {}, "speedup")
+    if so and sn is not None:           # lower pipeline speedup = regression
+        sfrac = (sn - so) / so
+        out["pass_cycle_speedup"] = {"old": so, "new": sn,
+                                     "delta_frac": round(sfrac, 4)}
+        if sfrac < -threshold:
+            regressions.append(
+                f"pass_cycle.speedup {so:.2f} -> {sn:.2f} ({sfrac:+.1%})")
     oo = old.get("obs_stats") or {}
     on = new.get("obs_stats") or {}
     movers = []

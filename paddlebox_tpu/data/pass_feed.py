@@ -28,7 +28,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 import jax
@@ -37,7 +37,7 @@ import jax.numpy as jnp
 from paddlebox_tpu.config import DataFeedConfig, SlotConfig
 from paddlebox_tpu.data.batch_pack import BatchPacker
 from paddlebox_tpu.data.slot_record import SlotRecordBlock
-from paddlebox_tpu.utils import intervals
+from paddlebox_tpu.utils import intervals, workpool
 from paddlebox_tpu.utils.monitor import stat_observe
 
 
@@ -85,10 +85,27 @@ class HostPassArrays:
         return lo, max(0, min(self.batch_size, self.num_real - lo)), lo
 
 
+def _record_ranges(n: int, threads: int) -> List[tuple]:
+    """Split [0, n) into contiguous record ranges for the pack fan-out.
+    More chunks than threads (2×) smooths slot-length skew; a floor keeps
+    tiny passes from paying per-task overhead.  Pure partitioning —
+    workers write disjoint plane rows, so any split is bit-identical."""
+    if n == 0:
+        return []
+    if threads <= 1:
+        return [(0, n)]
+    chunks = min(threads * 2, max(1, n // 4096))
+    bounds = np.linspace(0, n, chunks + 1).astype(np.int64)
+    return [(int(bounds[i]), int(bounds[i + 1]))
+            for i in range(len(bounds) - 1) if bounds[i + 1] > bounds[i]]
+
+
 def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
               batch_size: int, label_slot="label",
               key_mapper=None, prebatched: bool = False,
-              batch_counts: Optional[Sequence[int]] = None
+              batch_counts: Optional[Sequence[int]] = None,
+              pack_threads: Optional[int] = None,
+              on_plane: Optional[Callable[[str, np.ndarray], None]] = None
               ) -> HostPassArrays:
     """Vectorized whole-pass pack: one call per slot, one key translation
     for every occurrence in the pass (vs per-batch searchsorted loops).
@@ -100,10 +117,27 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
     given as per-batch record counts over the CONCATENATED block order
     (dataset.batch_bounds) — no per-batch block copies needed.  Otherwise
     blocks are concatenated and sliced densely every batch_size records.
+
+    pack_threads: fan the per-slot/per-record-range pad+translate work
+    across the shared pack WorkPool (None = FLAGS_pass_pack_threads; an
+    explicit int uses a private pool of that size).  Every worker writes a
+    DISJOINT row range of the preallocated SoA planes, so the result is
+    bit-identical at any thread count (≙ the reference's per-device
+    PackBatchTask threads, boxps_worker.cc:1259).
+
+    on_plane: optional callable invoked on THIS thread as each finished
+    SoA plane becomes final — upload_pass's per-plane H2D overlap hook
+    (device dispatch stays on the pack coordinator thread).
     """
     t_pack = time.perf_counter()
     m_pack = time.monotonic()
     packer = BatchPacker(feed_config, batch_size, label_slot)
+    own_pool = None
+    if pack_threads is None:
+        pool = workpool.pack_pool()
+    else:
+        own_pool = pool = workpool.WorkPool(max(1, int(pack_threads)),
+                                            kind="pack")
     blocks = list(blocks)
     merged = SlotRecordBlock.concat(blocks)
     if batch_counts is not None:
@@ -148,91 +182,135 @@ def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
 
     indices = np.zeros((S, nb, L), dtype=np.int32)
     lengths = np.zeros((S, nb), dtype=np.int32)
-    for si, slot in enumerate(packer.sparse_slots):
+
+    def rows_of(r0: int, r1: int):
+        """Plane rows of record range [r0, r1) — a contiguous slice on the
+        dense path, a fancy-index slice of the position map otherwise."""
+        return pos[r0:r1] if isinstance(pos, np.ndarray) else slice(r0, r1)
+
+    def pack_sparse_range(si: int, slot, r0: int, r1: int) -> None:
         values, offsets = merged.uint64_slots[slot.name]
+        v = values[offsets[r0]:offsets[r1]]
+        o = offsets[r0:r1 + 1] - offsets[r0]
         if key_mapper is not None:
             # translate the ragged values ONCE (real occurrences only),
             # then pad the translated int32 plane
-            values = key_mapper(values)
-        elif len(values) and int(values.max()) > np.iinfo(np.int32).max:
+            v = key_mapper(v)
+        elif len(v) and int(v.max()) > np.iinfo(np.int32).max:
             raise ValueError(
                 "pack_pass without a key_mapper stores raw feasigns in the "
                 "int32 index plane; keys exceed int32 — pass the engine's "
                 "PassKeyMapper (engine.mapper)")
         # _pad_ragged zero-fills positions beyond each record's length, so
         # padding already lands on the reserved zero row — no re-mask pass
-        padded, lens = packer._pad_ragged(values, offsets, L)
-        indices[si, pos] = padded
-        lengths[si, pos] = lens
+        padded, lens = packer._pad_ragged(v, o, L)
+        rows = rows_of(r0, r1)
+        indices[si, rows] = padded
+        lengths[si, rows] = lens
 
-    dense = np.zeros((nb, packer.dense_dim), dtype=np.float32)
-    col = 0
-    for slot in packer.dense_slots:
-        values, offsets = merged.float_slots[slot.name]
-        padded, _ = packer._pad_ragged(values, offsets, slot.dim)
-        dense[pos, col:col + slot.dim] = padded
-        col += slot.dim
+    try:
+        # wave 1 — the heavy planes: every (sparse slot × record range)
+        # pad/translate task runs concurrently, each writing a disjoint
+        # [si, rows] region of the preallocated planes (bit-identical at
+        # any thread count: no accumulation, no ordering)
+        ranges = _record_ranges(n, pool.threads)
+        pool.map(lambda t: pack_sparse_range(*t),
+                 [(si, slot, r0, r1)
+                  for si, slot in enumerate(packer.sparse_slots)
+                  for r0, r1 in ranges])
+        if on_plane is not None:
+            on_plane("indices", indices)
+            on_plane("lengths", lengths)
 
-    multi = np.zeros((nb, len(packer.label_slots)), np.float32)
-    for t, name in enumerate(packer.label_slots):
-        src = merged.float_slots if name in merged.float_slots else \
-            merged.uint64_slots
-        if name in src:
-            lv, lo = src[name]
-            lp, _ = packer._pad_ragged(lv, lo, 1)
-            multi[pos, t] = lp[:, 0].astype(np.float32)
-    labels = multi if len(packer.label_slots) > 1 else multi[:, 0]
+        # wave 2 — the light per-record planes, one task per plane column
+        # group (dense slots / label columns / uid / aux), overlapping the
+        # caller's H2D dispatch of wave 1 when on_plane is staged
+        dense = np.zeros((nb, packer.dense_dim), dtype=np.float32)
+        multi = np.zeros((nb, len(packer.label_slots)), np.float32)
+        valid = np.zeros((nb,), dtype=bool)
+        uid = np.zeros((nb,), np.uint64) if feed_config.uid_slot else None
+        aux = {} if feed_config.string_slots else None
 
-    valid = np.zeros((nb,), dtype=bool)
-    valid[pos] = True
+        def pack_dense(slot, col: int) -> None:
+            values, offsets = merged.float_slots[slot.name]
+            padded, _ = packer._pad_ragged(values, offsets, slot.dim)
+            dense[pos, col:col + slot.dim] = padded
 
-    uid = None
-    if feed_config.uid_slot:
-        vals, offs = merged.uint64_slots[feed_config.uid_slot]
-        uid = np.zeros((nb,), np.uint64)
-        uid[pos] = packer._pad_ragged(vals, offs, 1)[0][:, 0]
+        def pack_label(t: int, name: str) -> None:
+            src = merged.float_slots if name in merged.float_slots else \
+                merged.uint64_slots
+            if name in src:
+                lv, lo = src[name]
+                lp, _ = packer._pad_ragged(lv, lo, 1)
+                multi[pos, t] = lp[:, 0].astype(np.float32)
 
-    aux = None
-    if feed_config.string_slots:
-        # InputTable index planes (≙ InputTableDataFeed, data_feed.h:2224)
-        aux = {}
-        for slot in feed_config.string_slots:
+        def pack_uid() -> None:
+            vals, offs = merged.uint64_slots[feed_config.uid_slot]
+            uid[pos] = packer._pad_ragged(vals, offs, 1)[0][:, 0]
+
+        def pack_aux(slot) -> None:
+            # InputTable index planes (≙ InputTableDataFeed,
+            # data_feed.h:2224)
             vals, offs = merged.aux_slots[slot.name]
             padded, _ = packer._pad_ragged(vals, offs, slot.capacity)
             plane = np.zeros((nb, slot.capacity), np.int32)
             plane[pos] = padded.astype(np.int32)
             aux[slot.name] = plane
 
+        tasks: List[Callable[[], None]] = []
+        col = 0
+        for slot in packer.dense_slots:
+            tasks.append(functools.partial(pack_dense, slot, col))
+            col += slot.dim
+        for t, name in enumerate(packer.label_slots):
+            tasks.append(functools.partial(pack_label, t, name))
+        if uid is not None:
+            tasks.append(pack_uid)
+        if aux is not None:
+            for slot in feed_config.string_slots:
+                tasks.append(functools.partial(pack_aux, slot))
+        pool.map(lambda fn: fn(), tasks)
+        valid[pos] = True
+    finally:
+        if own_pool is not None:
+            own_pool.shutdown()
+    labels = multi if len(packer.label_slots) > 1 else multi[:, 0]
+    if on_plane is not None:
+        on_plane("dense", dense)
+        on_plane("labels", labels)
+        on_plane("valid", valid)
+        if aux:
+            for name, plane in aux.items():
+                on_plane(name, plane)
+
     out = HostPassArrays(indices=indices, lengths=lengths, dense=dense,
                          labels=labels, valid=valid, n_batches=n_batches,
                          batch_size=batch_size, num_real=n,
                          ins_ids=merged.ins_ids, batch_real=batch_real,
                          batch_base=batch_base, aux=aux, uid=uid)
+    # wave 3 — pv planes, vectorized over the WHOLE pass (the former
+    # per-batch python loops; bit-identical, see rank_offset.py) and
+    # metered apart from pad/translate cost
+    t_planes = time.perf_counter()
     if feed_config.rank_offset:
         # ≙ GetRankOffset per batch (data_feed.cc:1855) — batch-local row
         # indices; meaningful under pv grouping (whole pvs per batch)
-        from paddlebox_tpu.data.rank_offset import build_rank_offset
-        cols = 2 * feed_config.max_rank + 1
-        out.rank_offset = np.full((nb, cols), -1, np.int32)
-        for i in range(n_batches):
-            lo, cnt, base = out.real_range(i)
-            if cnt == 0:
-                continue
-            sl = slice(base, base + cnt)
-            out.rank_offset[lo:lo + batch_size] = build_rank_offset(
-                None if merged.search_ids is None else merged.search_ids[sl],
-                None if merged.cmatch is None else merged.cmatch[sl],
-                None if merged.rank is None else merged.rank[sl],
-                batch_size, feed_config.max_rank)
+        from paddlebox_tpu.data.rank_offset import build_rank_offset_batched
+        out.rank_offset = build_rank_offset_batched(
+            merged.search_ids, merged.cmatch, merged.rank,
+            batch_real, batch_base, batch_size, feed_config.max_rank)
+        if on_plane is not None:
+            on_plane("rank_offset", out.rank_offset)
     if feed_config.ads_offset:
         # ≙ GetAdsOffset per batch (data_feed.cc:3592): pv prefix offsets
-        from paddlebox_tpu.data.rank_offset import build_ads_offset
-        out.ads_offset = np.zeros((n_batches, batch_size + 1), np.int32)
-        for i in range(n_batches):
-            lo, cnt, base = out.real_range(i)
-            sid = (None if merged.search_ids is None
-                   else merged.search_ids[base:base + cnt])
-            out.ads_offset[i] = build_ads_offset(sid, cnt, batch_size)
+        from paddlebox_tpu.data.rank_offset import build_ads_offset_batched
+        out.ads_offset = build_ads_offset_batched(
+            merged.search_ids, batch_real, batch_base, batch_size)
+        if on_plane is not None:
+            on_plane("ads_offset", out.ads_offset)
+    if feed_config.rank_offset or feed_config.ads_offset:
+        stat_observe("data.pass_feed.plane_build_s",
+                     time.perf_counter() - t_planes)
     # pass-feed pack latency: whole-pass + amortized per-batch (the host
     # cost the pass-resident feed exists to keep out of the train loop)
     dt = time.perf_counter() - t_pack
@@ -351,8 +429,52 @@ def _build_static_planes(plans, labels_all, slot_ids, dims, eff, shape_slb):
     return jax.lax.map(lambda args: one(*args), (plans, labels_all))
 
 
+def _h2d_sharding(name: str, sharding):
+    """The H2D (pre-relayout) sharding of one SoA plane — record dim split
+    over the mesh's dp axes so the full pass never materializes on one
+    device; ads_offset (tiny per-batch plane) replicates."""
+    if sharding is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = next(iter(sharding.values())).mesh
+    spec = sharding["valid"].spec[1]    # the dp axes tuple
+    if name == "indices":
+        return NamedSharding(mesh, P(None, spec, None))
+    if name == "lengths":
+        return NamedSharding(mesh, P(None, spec))
+    if name in ("dense", "labels", "valid"):
+        return NamedSharding(mesh, P(spec))
+    if name == "ads_offset":
+        return NamedSharding(mesh, P())
+    return NamedSharding(mesh, P(spec, None))   # rank_offset / aux planes
+
+
+def _put_plane(name: str, a: np.ndarray, sharding):
+    sh = _h2d_sharding(name, sharding)
+    return jnp.asarray(a) if sh is None else jax.device_put(a, sh)
+
+
+class PlaneStager:
+    """Overlap H2D with pack: pack_pass invokes this (``on_plane``) as
+    each SoA plane finishes, dispatching its ``device_put`` immediately so
+    the upload hides behind the remaining host pack; ``upload_pass`` then
+    skips the already-staged planes.  Dispatch happens on the pack
+    coordinator's thread only — never on pool workers (concurrent device
+    dispatch from several python threads can deadlock single-stream
+    runtimes, ps/pass_manager.py)."""
+
+    def __init__(self, sharding=None):
+        self.sharding = sharding
+        self.staged: Dict[str, jnp.ndarray] = {}
+
+    def __call__(self, name: str, a: np.ndarray) -> None:
+        t0 = time.monotonic()
+        self.staged[name] = _put_plane(name, a, self.sharding)
+        intervals.record("upload", t0, time.monotonic())
+
+
 def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
-                sharding=None) -> PackedPassFeed:
+                sharding=None, staged=None) -> PackedPassFeed:
     """H2D once + one relayout jit into the step-ready stacked layout.
 
     sharding: optional {name: jax.sharding.Sharding} — under a topology the
@@ -360,30 +482,22 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
     the per-batch path's _put_batch placement.  The H2D upload itself is
     already sharded (record dim split over the mesh) so the full pass never
     materializes on a single device; the relayout then runs under GSPMD and
-    the result is device_put to the final batch-dim shardings."""
+    the result is device_put to the final batch-dim shardings.
+
+    staged: optional PlaneStager (or its dict) holding planes whose H2D
+    was already dispatched during pack — those skip the put here; with no
+    stager every plane uploads all-at-once (the parallel-packer-off
+    path)."""
     t_up = time.perf_counter()
     m_up = time.monotonic()
     h = host_arrays
     N, B = h.n_batches, h.batch_size
-    in_shardings = {}
-    if sharding is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        mesh = next(iter(sharding.values())).mesh
-        spec = sharding["valid"].spec[1]    # the dp axes tuple
-        in_shardings = {
-            "indices": NamedSharding(mesh, P(None, spec, None)),
-            "lengths": NamedSharding(mesh, P(None, spec)),
-            "dense": NamedSharding(mesh, P(spec)),
-            "labels": NamedSharding(mesh, P(spec)),
-            "valid": NamedSharding(mesh, P(spec)),
-        }
-        for k in h.extra_planes():
-            in_shardings[k] = NamedSharding(mesh, P(spec, None))
+    pre = dict(getattr(staged, "staged", staged) or {})
 
     def put(name, a):
-        if name in in_shardings:
-            return jax.device_put(a, in_shardings[name])
-        return jnp.asarray(a)
+        if name in pre:
+            return pre[name]
+        return _put_plane(name, a, sharding)
 
     dev = {
         "indices": put("indices", h.indices),   # [S, N*B, L]
@@ -397,13 +511,7 @@ def upload_pass(host_arrays: HostPassArrays, keep_host: bool = False,
     if h.ads_offset is not None:
         # tiny per-batch plane, replicated over the mesh (a plain
         # process-local array cannot mix with global arrays under jit)
-        if sharding is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            mesh0 = next(iter(sharding.values())).mesh
-            dev["ads_offset"] = jax.device_put(
-                h.ads_offset, NamedSharding(mesh0, P()))
-        else:
-            dev["ads_offset"] = jnp.asarray(h.ads_offset)
+        dev["ads_offset"] = put("ads_offset", h.ads_offset)
     data = _relayout(dev, N, B)
     if sharding is not None:
         data = {k: jax.device_put(v, sharding[k]) if k in sharding else v

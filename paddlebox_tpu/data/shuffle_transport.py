@@ -122,6 +122,7 @@ class TcpShuffleTransport(ShuffleTransport):
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
         self._listener.listen(self._world)
+        # pboxlint: disable-next=PB405 -- listener pump lives for the transport; close() unblocks it via listener shutdown
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
@@ -141,6 +142,7 @@ class TcpShuffleTransport(ShuffleTransport):
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            # pboxlint: disable-next=PB405 -- per-peer receiver, bounded by world size; dies with its socket
             threading.Thread(target=self._recv_loop, args=(conn,),
                              daemon=True).start()
 

@@ -177,3 +177,64 @@ def train_from_dataset(trainer: SparseTrainer, dataset: BoxPSDataset,
     """≙ Executor.train_from_dataset (executor.py:2412 →
     BoxPSTrainer::Run)."""
     return trainer.train_pass(dataset.dataset)
+
+
+def train_passes(trainer: SparseTrainer, dataset: BoxPSDataset,
+                 passes: Sequence[Sequence[str]], date: Optional[str] = None,
+                 before_pass=None, prefetch: Optional[bool] = None,
+                 ) -> list:
+    """Day loop over per-pass filelists — the reference's
+    set_date/load_into_memory/begin_pass/train/end_pass sequence
+    (dataset.py:1231 usage), pipelined when ``FLAGS_pass_prefetch`` is on:
+    while pass N trains, pass N+1's read + key dedup + table pull + pack
+    run on the prefetcher's background threads (data/prefetch.py), so the
+    device never waits on the host between passes.  Results are
+    bit-identical either way (tests/test_pass_pipeline.py).
+
+    passes: one filelist per pass.  before_pass(dataset) runs after the
+    load, inside the pass's feed window — e.g.
+    ``lambda ds: ds.preprocess_instance()`` for pv-grouped training.
+    prefetch: override the flag (None = read FLAGS_pass_prefetch).
+    Returns the per-pass train metrics."""
+    from paddlebox_tpu import flags as _flags
+    from paddlebox_tpu.data.prefetch import PassPrefetcher
+    engine, ds = dataset.engine, dataset.dataset
+    if date is not None:
+        dataset.set_date(date)
+    if prefetch is None:
+        prefetch = bool(_flags.get_flags("pass_prefetch"))
+    metrics = []
+    if not prefetch:
+        for filelist in passes:
+            dataset.set_filelist(filelist)
+            dataset.load_into_memory()
+            if before_pass is not None:
+                before_pass(ds)
+            dataset.begin_pass()
+            feed = trainer.build_pass_feed(ds)
+            metrics.append(trainer.train_pass(feed))
+            dataset.end_pass()
+        return metrics
+
+    def load(filelist):
+        # runs on the prefetch worker INSIDE the feed window the
+        # prefetcher opened (begin_feed_pass is its job, not ours)
+        ds.set_filelist(filelist)
+        ds.load_into_memory()       # reader threads feed keys to engine
+        if before_pass is not None:
+            before_pass(ds)
+        return ds
+
+    pf = PassPrefetcher(engine, trainer)
+    try:
+        for filelist in passes:
+            pf.submit(lambda fl=filelist: load(fl))
+        for _ in passes:
+            feed = pf.next_pass()
+            metrics.append(trainer.train_pass(feed))
+            # NOT dataset.end_pass(): its release_memory would drop the
+            # blocks the worker already loaded for the NEXT pass
+            pf.end_pass()
+    finally:
+        pf.close()
+    return metrics

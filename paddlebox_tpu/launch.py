@@ -396,6 +396,16 @@ def main():
                          "worker (FLAGS_ps_table_threads; per-shard "
                          "pull/write/save/load fan across it, 1 = "
                          "sequential)")
+    ap.add_argument("--pack_threads", type=int, default=None,
+                    help="whole-pass packer pool size on every worker "
+                         "(FLAGS_pass_pack_threads; per-slot/record-range "
+                         "pad+translate fan across it, bit-identical at "
+                         "any setting, 1 = sequential)")
+    ap.add_argument("--pass_prefetch", type=int, default=None,
+                    choices=(0, 1),
+                    help="pipeline the pass feed on every worker "
+                         "(FLAGS_pass_prefetch): pass N+1's load/pull/"
+                         "pack run in the background while pass N trains")
     ap.add_argument("--obs_port", type=int, default=0,
                     help="observability exporter base port: worker rank r "
                          "serves /metrics + /statz + /tracez + /flightz "
@@ -429,6 +439,12 @@ def main():
     if args.ps_table_threads is not None:
         # pboxlint: disable-next=PB203 -- env export to spawned workers
         os.environ["FLAGS_ps_table_threads"] = str(args.ps_table_threads)
+    if args.pack_threads is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_pass_pack_threads"] = str(args.pack_threads)
+    if args.pass_prefetch is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_pass_prefetch"] = str(args.pass_prefetch)
     if args.obs_flight_ring is not None:
         # pboxlint: disable-next=PB203 -- env export to spawned workers
         os.environ["FLAGS_obs_flight_ring"] = str(args.obs_flight_ring)

@@ -336,6 +336,7 @@ class ChaosProxy:
         self._conns: set = set()
         self._listener = socket.create_server((host, port))
         self.addr: Tuple[str, int] = self._listener.getsockname()
+        # pboxlint: disable-next=PB405 -- chaos-proxy listener pump; close() stops it via listener shutdown
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
@@ -370,6 +371,7 @@ class ChaosProxy:
                 client, _ = self._listener.accept()
             except OSError:
                 return
+            # pboxlint: disable-next=PB405 -- per-connection fault injector; dies with its socket pair
             threading.Thread(target=self._serve_conn, args=(client,),
                              daemon=True).start()
 
@@ -419,7 +421,9 @@ class ChaosProxy:
                     self._track(s, False)
                     _close_quietly(s)
 
+        # pboxlint: disable-next=PB405 -- byte pump dies when either socket closes
         threading.Thread(target=pump, args=(client, upstream, "send"),
                          daemon=True).start()
+        # pboxlint: disable-next=PB405 -- byte pump dies when either socket closes
         threading.Thread(target=pump, args=(upstream, client, "recv"),
                          daemon=True).start()

@@ -96,12 +96,16 @@ class BoxPSEngine:
             self._agent_keys = []
         # per-pass observability baseline: the end_pass report prints
         # DELTAS against these (wire bytes, faults, timer seconds of this
-        # pass only).  Coordinator-only, like the lifecycle flag below.
-        self._pass_stats0 = stat_snapshot("ps.")
-        self._pass_timers0 = {n: (s, c) for n, s, c in self.timers.rows()}
-        # feed-gap window anchor: end_pass computes the pass's
-        # device_busy_frac / feed_gap_ratio over [here, write-back done]
-        self._pass_m0 = time.monotonic()
+        # pass only).  Held PENDING until begin_pass promotes it — under
+        # pass prefetch, pass N+1's begin_feed_pass runs while pass N is
+        # still training, and must not clobber N's open window.
+        self._feed_obs0 = {
+            "stats0": stat_snapshot("ps."),
+            "timers0": {n: (s, c) for n, s, c in self.timers.rows()},
+            # feed-gap window anchor: end_pass computes the pass's
+            # device_busy_frac / feed_gap_ratio over [here, write-back]
+            "m0": time.monotonic(),
+        }
         flight.record("pass_feed_begin", pass_id=self.pass_id + 1,
                       day=self.day_id)
         # the pass lifecycle is driven by one coordinator thread;
@@ -224,6 +228,20 @@ class BoxPSEngine:
                 "async working-set build failed (end_feed_pass "
                 "background thread)") from err
 
+    def peek_next_mapper(self) -> Optional[embedding.PassKeyMapper]:
+        """The key mapper the NEXT begin_pass will adopt — available as
+        soon as the async host build finishes (this waits on it), WITHOUT
+        adopting the working set.  The pass prefetcher packs pass N+1's
+        feed against this on a background thread while pass N still
+        trains; key translation reads only the sorted key array, which
+        begin_pass's stale-row refresh never mutates (it rewrites working-
+        set VALUES), so the pre-adoption pack is bit-identical to packing
+        after adoption."""
+        self.wait_feed_pass_done()
+        if self._next is not None:
+            return self._next[0]
+        return self.mapper
+
     # -- train pass ----------------------------------------------------------
     def begin_pass(self) -> None:
         with trace.span("ps.engine.begin_pass", pass_id=self.pass_id + 1):
@@ -236,6 +254,13 @@ class BoxPSEngine:
                 self._refresh_stale_rows()
             assert self.ws is not None, \
                 "end_feed_pass must run before begin_pass"
+            # promote the pending feed-time baseline: THIS pass's report
+            # window (prefetch keeps N+1's pending window separate while
+            # N's promoted one is still open)
+            obs0 = getattr(self, "_feed_obs0", None)
+            if obs0 is not None:
+                self._pass_obs0 = obs0
+                self._feed_obs0 = None
             self.pass_id += 1
             flight.record("pass_begin", pass_id=self.pass_id,
                           keys=self.num_keys)
@@ -323,12 +348,18 @@ class BoxPSEngine:
         # feed-gap attribution over THIS pass's window (begin_feed_pass →
         # write-back done), overlap-aware: surfaces in /statz, the
         # per-pass report, and the BENCH result JSON (ROADMAP item 2)
-        m0 = getattr(self, "_pass_m0", None)
+        obs0 = getattr(self, "_pass_obs0", None) or {}
+        m0 = obs0.get("m0")
         if m0 is not None:
             rep = intervals.report(since=m0)
             self._pass_feed_report = rep
             stat_set("feed.device_busy_frac", rep["device_busy_frac"])
             stat_set("feed.feed_gap_ratio", rep["feed_gap_ratio"])
+            # per-stage prefetch-hidden seconds: host feed work that ran
+            # UNDER device busy — the pipelined engine's win in /statz
+            for k in ("pull", "pack", "upload", "write"):
+                # pboxlint: disable-next=PB204 -- closed kind set (intervals.KINDS)
+                stat_set(f"feed.{k}_hidden_s", rep.get(f"{k}_hidden_s", 0.0))
         flight.record("pass_end", pass_id=self.pass_id,
                       keys=self.num_keys)
         if flags.get_flags("obs_pass_report"):
@@ -385,8 +416,9 @@ class BoxPSEngine:
         and injected-fault counts — the at-a-glance answer to "was this
         pass pull-bound, train-bound or write-bound?".  Printed at every
         end_pass under ``FLAGS_obs_pass_report``."""
-        stats0 = getattr(self, "_pass_stats0", None) or {}
-        timers0 = getattr(self, "_pass_timers0", None) or {}
+        obs0 = getattr(self, "_pass_obs0", None) or {}
+        stats0 = obs0.get("stats0") or {}
+        timers0 = obs0.get("timers0") or {}
         cur = stat_snapshot("ps.")
 
         def delta(key: str) -> float:
@@ -445,4 +477,12 @@ class BoxPSEngine:
                 f"upload={rep['upload_busy_s']:.3f}s "
                 f"write={rep['write_busy_s']:.3f}s "
                 f"overlapped_with_device={rep['overlap_s']:.3f}s")
+            hidden = {k: rep.get(f"{k}_hidden_s", 0.0)
+                      for k in ("pull", "pack", "upload", "write")}
+            if any(v > 1e-9 for v in hidden.values()):
+                # per-stage feed work hidden behind device busy — the
+                # prefetch pipeline's visible effect (data/prefetch.py)
+                lines.append(
+                    "  prefetch hidden: " + " ".join(
+                        f"{k}={v:.3f}s" for k, v in hidden.items()))
         return "\n".join(lines)

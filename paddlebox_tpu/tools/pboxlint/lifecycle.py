@@ -16,6 +16,16 @@
          one that is never ``shutdown()``-ed in its owning scope and not
          managed by a ``with`` statement — its non-daemon workers hang
          interpreter shutdown exactly like a forgotten PB401 thread.
+  PB405  a raw ``threading.Thread`` whose ``target=`` resolves in-module
+         to a function containing a loop (recurring work) and that is
+         never ``.join()``-ed in its owning scope — recurring work
+         belongs on a managed surface (``utils/workpool.WorkPool``, a
+         named executor, or a thread with an explicit join lifecycle);
+         an unjoined pump thread outlives errors silently and cannot be
+         drained at shutdown.  One-shot handoff threads (no loop in the
+         target) and unresolvable targets (dynamic callables, foreign
+         receivers like ``srv.serve_forever``) are not flagged;
+         legitimate long-lived pumps/dispatchers suppress with a reason.
 
 Queue-typed receivers are recognized syntactically: any name (local or
 ``self.X``) assigned from a ``queue.Queue``-family constructor or from a
@@ -132,6 +142,87 @@ def _check_threads(mod: Module) -> List[Finding]:
                     "anonymous thread started without an explicit "
                     "daemon= — it can never be joined and a non-daemon "
                     "default hangs interpreter shutdown"))
+    return findings
+
+
+def _thread_target_def(mod: Module, call: ast.Call) -> Optional[ast.AST]:
+    """The in-module def a Thread ctor's ``target=`` resolves to: a
+    module/local function for ``target=name``, a method def for
+    ``target=self.name``.  None for dynamic / foreign targets (lambdas
+    cannot hold loops; ``obj.method`` on a non-self receiver is another
+    object's lifecycle)."""
+    for kw in call.keywords:
+        if kw.arg != "target":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Name):
+            name = v.id
+        elif (isinstance(v, ast.Attribute)
+                and isinstance(v.value, ast.Name)
+                and v.value.id in ("self", "cls")):
+            name = v.attr
+        else:
+            return None
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name == name):
+                return node
+    return None
+
+
+def _has_loop(fn: ast.AST) -> bool:
+    return any(isinstance(n, (ast.While, ast.For)) for n in ast.walk(fn))
+
+
+def _check_recurring_threads(mod: Module) -> List[Finding]:
+    """PB405 — recurring work on a raw unjoined thread."""
+    findings: List[Finding] = []
+    parent = {}
+    for node in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(node):
+            parent[child] = node
+
+    def owning_scope(node: ast.AST, want_class: bool) -> ast.AST:
+        cur = parent.get(node)
+        while cur is not None:
+            if want_class and isinstance(cur, ast.ClassDef):
+                return cur
+            if not want_class and isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = parent.get(cur)
+        return mod.tree
+
+    def flag(call: ast.Call, label: str) -> None:
+        findings.append(Finding(
+            mod.path, call.lineno, "PB405",
+            f"{label} runs a looping target on a raw thread with no "
+            f"join in its owning scope — recurring work belongs on "
+            f"WorkPool/a named executor, or join the thread (managed "
+            f"lifecycle); suppress with a reason for deliberate "
+            f"long-lived pumps"))
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and _is_thread_ctor(node.value):
+            call = node.value
+            fn = _thread_target_def(mod, call)
+            if fn is None or not _has_loop(fn):
+                continue
+            for name, is_self in map(_target_name, node.targets):
+                if name is None:
+                    continue
+                scope = owning_scope(node, want_class=is_self)
+                if (name, is_self) in _method_calls_on(scope, "join"):
+                    continue
+                flag(call, f"thread {name!r}")
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            inner = node.value                # Thread(...).start(): unjoinable
+            if (isinstance(inner.func, ast.Attribute)
+                    and inner.func.attr == "start"
+                    and _is_thread_ctor(inner.func.value)):
+                fn = _thread_target_def(mod, inner.func.value)
+                if fn is not None and _has_loop(fn):
+                    flag(inner.func.value, "anonymous thread")
     return findings
 
 
@@ -271,4 +362,4 @@ def _check_executors(mod: Module) -> List[Finding]:
 
 def check(mod: Module, ctx: PackageContext) -> List[Finding]:
     return (_check_threads(mod) + _check_queue_gets(mod)
-            + _check_executors(mod))
+            + _check_executors(mod) + _check_recurring_threads(mod))

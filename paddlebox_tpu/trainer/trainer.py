@@ -604,13 +604,15 @@ class SparseTrainer:
     # INDEX and dynamic-slices device-resident stacked arrays; plans for
     # the mxu path are precomputed at pass-build time, so the hot step
     # contains no sorts and no host work at all.
-    def build_pass_feed(self, dataset: SlotDataset,
-                        keep_host: bool = False) -> PackedPassFeed:
-        """Pack + translate + upload the whole pass, and (mxu path)
-        precompute the per-batch sorted-spmm plans.  Runs at pass-build
-        time — the train loop then touches no per-batch host work."""
+    def pack_pass_host(self, dataset: SlotDataset, mapper=None,
+                       on_plane=None) -> "pass_feed.HostPassArrays":
+        """Host half of :meth:`build_pass_feed`: pack + translate the
+        whole pass into SoA planes.  No device dispatch (unless the caller
+        passes an ``on_plane`` stager) and no dependence on the ADOPTED
+        working set — with an explicit ``mapper`` (e.g.
+        ``engine.peek_next_mapper()``) the prefetcher runs this on a
+        background thread while the previous pass still trains."""
         from paddlebox_tpu.data import pass_feed as pf
-        assert self.engine.ws is not None, "engine lifecycle must run first"
         self._require_pv_for_rank(dataset)
         label = (self.packer.label_slots
                  if len(self.packer.label_slots) > 1 else self.packer.label_slot)
@@ -623,28 +625,44 @@ class SparseTrainer:
         if getattr(dataset, "_pv_grouped", False):
             counts = [hi - lo
                       for lo, hi in dataset.batch_bounds(self.batch_size)]
-        arrays = pf.pack_pass(dataset.get_blocks(), self.packer.config,
-                              self.batch_size, label,
-                              key_mapper=self.engine.mapper,
-                              batch_counts=counts)
+        return pf.pack_pass(dataset.get_blocks(), self.packer.config,
+                            self.batch_size, label,
+                            key_mapper=(self.engine.mapper if mapper is None
+                                        else mapper),
+                            batch_counts=counts, on_plane=on_plane)
+
+    def pass_shardings(self, arrays) -> Optional[dict]:
+        """The resident pass's target shardings under a topology (batch
+        dims dp-wise, mirroring _put_batch) — None single-device."""
+        if self.topology is None:
+            return None
+        t = self.topology
+        dp = ("dp", "sharding")
+        shardings = {
+            "indices": t.sharding(None, None, None, dp),  # [N,S,L,B]
+            "lengths": t.sharding(None, None, dp),        # [N,S,B]
+            "dense": t.sharding(None, dp, None),          # [N,B,D]
+            "labels": (t.sharding(None, dp) if arrays.labels.ndim == 1
+                       else t.sharding(None, dp, None)),
+            "valid": t.sharding(None, dp),
+        }
+        for k in arrays.extra_planes():
+            shardings[k] = t.sharding(None, dp, None)
+        return shardings
+
+    def finish_pass_feed(self, arrays, keep_host: bool = False,
+                         staged=None) -> PackedPassFeed:
+        """Device half of :meth:`build_pass_feed`: upload + relayout the
+        packed planes and (mxu paths) precompute per-batch plans.  Needs
+        the pass's working set ADOPTED (plan dims read ws height), so the
+        prefetcher calls this on the MAIN thread right after
+        engine.begin_pass()."""
+        from paddlebox_tpu.data import pass_feed as pf
+        assert self.engine.ws is not None, "engine lifecycle must run first"
         keep = keep_host or bool(self.trainer_config.dump_path)
-        shardings = None
-        if self.topology is not None:
-            # mirror _put_batch: batch dims shard dp-wise so the resident
-            # pass is distributed, not replicated on one device
-            t = self.topology
-            dp = ("dp", "sharding")
-            shardings = {
-                "indices": t.sharding(None, None, None, dp),  # [N,S,L,B]
-                "lengths": t.sharding(None, None, dp),        # [N,S,B]
-                "dense": t.sharding(None, dp, None),          # [N,B,D]
-                "labels": (t.sharding(None, dp) if arrays.labels.ndim == 1
-                           else t.sharding(None, dp, None)),
-                "valid": t.sharding(None, dp),
-            }
-            for k in arrays.extra_planes():
-                shardings[k] = t.sharding(None, dp, None)
-        feed = pf.upload_pass(arrays, keep_host=keep, sharding=shardings)
+        feed = pf.upload_pass(arrays, keep_host=keep,
+                              sharding=self.pass_shardings(arrays),
+                              staged=staged)
         path = self._resolve_path()
         if path == "mxu":
             from paddlebox_tpu.ops import sorted_spmm as sp
@@ -661,6 +679,17 @@ class SparseTrainer:
         elif path == "mxu_sharded":
             self._precompute_sharded_plans(feed)
         return feed
+
+    def build_pass_feed(self, dataset: SlotDataset,
+                        keep_host: bool = False) -> PackedPassFeed:
+        """Pack + translate + upload the whole pass, and (mxu path)
+        precompute the per-batch sorted-spmm plans.  Runs at pass-build
+        time — the train loop then touches no per-batch host work.
+        Composition of pack_pass_host + finish_pass_feed (the prefetcher
+        drives the halves on separate threads)."""
+        assert self.engine.ws is not None, "engine lifecycle must run first"
+        arrays = self.pack_pass_host(dataset)
+        return self.finish_pass_feed(arrays, keep_host=keep_host)
 
     def _sharded_layout(self):
         """(batch_axes, tbl_axes, n_tbl, rows_loc, multinode) of the

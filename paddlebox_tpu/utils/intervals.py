@@ -154,6 +154,15 @@ class IntervalRecorder:
         dev_m = _merge(iv["device"], since, until)
         out["host_busy_s"] = sum(t1 - t0 for t0, t1 in host_m)
         out["overlap_s"] = _intersect_seconds(dev_m, host_m)
+        # per-stage overlap: seconds of each host kind hidden behind
+        # device busy — the prefetch pipeline's win is exactly these
+        # going from ~0 (serial: host runs while the device idles) to
+        # ≈{k}_busy_s (pipelined: pass N+1's pull/pack/upload run under
+        # pass N's training)
+        for k in _HOST_KINDS:
+            out[f"{k}_hidden_s"] = _intersect_seconds(
+                _merge(iv[k], since, until), dev_m)
+        out["hidden_s"] = out["overlap_s"]
         dev = out["device_busy_s"]
         out["device_busy_frac"] = dev / wall
         # wall / device-busy: 1.0 = perfectly fed; BENCH_r03's ~20×

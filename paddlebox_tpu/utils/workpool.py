@@ -52,6 +52,15 @@ flags.define_flag(
     "1 = sequential on the caller's thread; results are bit-identical "
     "at any setting")
 
+flags.define_flag(
+    "pass_pack_threads", min(4, os.cpu_count() or 1),
+    "worker threads of the whole-pass packer (data/pass_feed.pack_pass): "
+    "per-slot plane builds and record-range partitions of the pad/"
+    "translate work fan across it, each worker writing disjoint rows of "
+    "the preallocated SoA planes (numpy pad/searchsorted releases the "
+    "GIL).  1 = sequential on the caller's thread; results are "
+    "bit-identical at any setting")
+
 
 class WorkPool:
     """A named, metered ThreadPoolExecutor wrapper with an inline
@@ -154,6 +163,9 @@ class WorkPool:
 _POOL: Optional[WorkPool] = None
 _POOL_LOCK = threading.Lock()
 
+_PACK_POOL: Optional[WorkPool] = None
+_PACK_POOL_LOCK = threading.Lock()
+
 
 def table_pool() -> WorkPool:
     """The process-wide shard pool, sized by ``FLAGS_ps_table_threads``.
@@ -168,6 +180,21 @@ def table_pool() -> WorkPool:
             if old is not None:
                 old.shutdown()
         return _POOL
+
+
+def pack_pool() -> WorkPool:
+    """The process-wide whole-pass pack pool, sized by
+    ``FLAGS_pass_pack_threads`` — same re-read/resize contract as
+    :func:`table_pool`, separate so a deep table fan-out can never starve
+    the pass packer (and vice versa)."""
+    global _PACK_POOL
+    want = max(1, int(flags.get_flags("pass_pack_threads")))
+    with _PACK_POOL_LOCK:
+        if _PACK_POOL is None or _PACK_POOL.threads != want:
+            old, _PACK_POOL = _PACK_POOL, WorkPool(want, kind="pack")
+            if old is not None:
+                old.shutdown()
+        return _PACK_POOL
 
 
 def pool_state() -> Optional[dict]:

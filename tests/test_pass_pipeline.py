@@ -1,0 +1,479 @@
+"""Pipelined pass-feed engine (ISSUE 8): parallel pack bit-identity over
+the full plane surface, batched pv-plane builders vs the per-batch
+reference, prefetched multi-day training parity (including under fault
+injection), and the parallel-pack speedup floor.
+
+The contract under test: FLAGS_pass_pack_threads and FLAGS_pass_prefetch
+change WALL CLOCK only — every plane, every loss, and the final table
+state are bit-identical to the serial single-threaded pass loop.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import flags
+from paddlebox_tpu.config import (DataFeedConfig, EmbeddingTableConfig,
+                                  SlotConfig, SparseSGDConfig)
+from paddlebox_tpu.data import pass_feed as pf
+from paddlebox_tpu.data.dataset import SlotDataset
+from paddlebox_tpu.data.prefetch import PassPrefetcher
+from paddlebox_tpu.data.rank_offset import (build_ads_offset,
+                                            build_ads_offset_batched,
+                                            build_rank_offset,
+                                            build_rank_offset_batched)
+from paddlebox_tpu.data.slot_record import SlotRecordBlock
+from paddlebox_tpu.models.deepfm import DeepFM
+from paddlebox_tpu.ps.embedding import PassKeyMapper
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.trainer.trainer import SparseTrainer
+
+S, CAP, D = 5, 3, 4
+
+
+# ---------------------------------------------------------------------------
+# Pack bit-identity: 1 thread vs 4 threads, full plane surface.
+# ---------------------------------------------------------------------------
+
+def _rich_cfg(pv: bool) -> DataFeedConfig:
+    """Every optional plane at once: uid slot, InputTable aux slot, and
+    (pv variants) rank_offset + ads_offset."""
+    extra = dict(rank_offset=True, ads_offset=True) if pv else {}
+    return DataFeedConfig(slots=tuple(
+        [SlotConfig("label", dtype="float", is_dense=True, dim=1),
+         SlotConfig("dense0", dtype="float", is_dense=True, dim=D),
+         SlotConfig("user", dtype="string", capacity=2)]
+        + [SlotConfig(f"s{i}", slot_id=100 + i, capacity=CAP)
+           for i in range(S)]), uid_slot="s0", **extra)
+
+
+def _rich_block(rng, n, n_keys=400, pv=False) -> SlotRecordBlock:
+    blk = SlotRecordBlock(n=n)
+    for i in range(S):
+        lens = rng.integers(1, CAP + 1, size=n)
+        off = np.zeros((n + 1,), np.int64)
+        np.cumsum(lens, out=off[1:])
+        blk.uint64_slots[f"s{i}"] = (
+            rng.integers(1, n_keys, size=int(off[-1])).astype(np.uint64), off)
+    blk.float_slots["label"] = (rng.integers(0, 2, n).astype(np.float32),
+                                np.arange(n + 1, dtype=np.int64))
+    blk.float_slots["dense0"] = (
+        rng.normal(0, 1, n * D).astype(np.float32),
+        np.arange(n + 1, dtype=np.int64) * D)
+    lens = rng.integers(1, 3, size=n)
+    off = np.zeros((n + 1,), np.int64)
+    np.cumsum(lens, out=off[1:])
+    blk.aux_slots["user"] = (
+        rng.integers(1, 50, size=int(off[-1])).astype(np.int32), off)
+    if pv:
+        blk.search_ids = np.sort(
+            rng.integers(0, n // 2 + 1, size=n).astype(np.uint64))
+        blk.cmatch = rng.choice([222, 223, 224, 0], size=n).astype(np.int32)
+        blk.rank = rng.integers(0, 5, size=n).astype(np.int32)
+    return blk
+
+
+_FIELDS = ("indices", "lengths", "dense", "labels", "valid", "uid",
+           "rank_offset", "ads_offset", "batch_real", "batch_base")
+
+
+@pytest.mark.parametrize("variant", ["dense", "prebatched", "counts"])
+def test_parallel_pack_bit_identical(variant):
+    """pack_pass at 4 threads == pack_pass at 1 thread, byte for byte,
+    on every plane it produces — the disjoint-row-writes argument holds
+    across the dense, prebatched, and batch_counts partitions."""
+    pv = variant != "dense"
+    cfg = _rich_cfg(pv)
+    B = 32
+    if variant == "dense":
+        blocks = [_rich_block(np.random.default_rng(s), 70 + 13 * s)
+                  for s in range(3)]
+        kwargs = {}
+    else:
+        ds = SlotDataset(cfg)
+        ds._blocks = [_rich_block(np.random.default_rng(9), 150, pv=True)]
+        ds.preprocess_instance()
+        if variant == "prebatched":
+            blocks = list(ds.batches(B))
+            kwargs = {"prebatched": True}
+        else:
+            blocks = ds.get_blocks()
+            kwargs = {"batch_counts": [hi - lo
+                                       for lo, hi in ds.batch_bounds(B)]}
+    keys = np.unique(np.concatenate(
+        [v[0] for b in blocks for v in b.uint64_slots.values()]))
+    mapper = PassKeyMapper(keys[keys != 0])
+
+    a1 = pf.pack_pass(blocks, cfg, B, key_mapper=mapper, pack_threads=1,
+                      **kwargs)
+    planes = []
+    a4 = pf.pack_pass(blocks, cfg, B, key_mapper=mapper, pack_threads=4,
+                      on_plane=lambda name, a: planes.append(name), **kwargs)
+
+    for f in _FIELDS:
+        x, y = getattr(a1, f), getattr(a4, f)
+        if x is None:
+            assert y is None, f
+        else:
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                          err_msg=f"field {f!r}")
+    assert a1.aux is not None and set(a1.aux) == set(a4.aux) == {"user"}
+    np.testing.assert_array_equal(a1.aux["user"], a4.aux["user"])
+    # the H2D overlap hook saw every device-bound plane exactly once
+    want = {"indices", "lengths", "dense", "labels", "valid", "user"}
+    if pv:
+        want |= {"rank_offset", "ads_offset"}
+    assert set(planes) == want and len(planes) == len(want)
+
+
+def test_pack_thread_count_flag_is_transparent():
+    """pack_threads=None reads FLAGS_pass_pack_threads; flipping the flag
+    must not change a single byte either."""
+    cfg = _rich_cfg(pv=False)
+    blocks = [_rich_block(np.random.default_rng(3), 90)]
+    keys = np.unique(np.concatenate(
+        [v[0] for v in blocks[0].uint64_slots.values()]))
+    mapper = PassKeyMapper(keys[keys != 0])
+    prev = flags.get_flags("pass_pack_threads")
+    try:
+        flags.set_flags({"pass_pack_threads": 1})
+        a1 = pf.pack_pass(blocks, cfg, 16, key_mapper=mapper)
+        flags.set_flags({"pass_pack_threads": 4})
+        a4 = pf.pack_pass(blocks, cfg, 16, key_mapper=mapper)
+    finally:
+        flags.set_flags({"pass_pack_threads": prev})
+    np.testing.assert_array_equal(a1.indices, a4.indices)
+    np.testing.assert_array_equal(a1.lengths, a4.lengths)
+    np.testing.assert_array_equal(a1.dense, a4.dense)
+
+
+# ---------------------------------------------------------------------------
+# Batched pv-plane builders vs the per-batch reference loop.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_batched_rank_ads_match_per_batch(seed):
+    """The whole-pass vectorized builders reproduce the per-batch loop
+    bit for bit — including empty batches, full batches, and pv runs
+    touching the batch boundary."""
+    rng = np.random.default_rng(seed)
+    B = 8
+    counts = [5, 8, 0, 3, 1, 8]         # empty + full batches included
+    batch_real = np.asarray(counts, np.int64)
+    batch_base = np.concatenate([[0], np.cumsum(batch_real)[:-1]])
+    m = int(batch_real.sum())
+    # pv runs contiguous within each batch (pv-aligned cuts never split a
+    # pv); distinct id ranges per batch keep the fixture honest
+    sid = np.concatenate([
+        np.sort(rng.integers(0, 4, size=c).astype(np.uint64)) + 100 * i
+        for i, c in enumerate(counts)]).astype(np.uint64)
+    cm = rng.choice([222, 223, 224, 0], size=m).astype(np.int32)
+    rk = rng.integers(0, 6, size=m).astype(np.int32)
+
+    got_r = build_rank_offset_batched(sid, cm, rk, batch_real, batch_base, B)
+    got_a = build_ads_offset_batched(sid, batch_real, batch_base, B)
+    want_r = np.full_like(got_r, -1)
+    for i, c in enumerate(counts):
+        b0 = int(batch_base[i])
+        want_r[i * B:(i + 1) * B] = build_rank_offset(
+            sid[b0:b0 + c], cm[b0:b0 + c], rk[b0:b0 + c], B)
+        np.testing.assert_array_equal(
+            got_a[i], build_ads_offset(sid[b0:b0 + c], c, B),
+            err_msg=f"ads_offset batch {i}")
+    np.testing.assert_array_equal(got_r, want_r)
+
+    # no pv data parsed -> all -1, same as the per-batch builder
+    none_r = build_rank_offset_batched(None, None, None,
+                                       batch_real, batch_base, B)
+    assert none_r.shape == got_r.shape and np.all(none_r == -1)
+
+
+# ---------------------------------------------------------------------------
+# Prefetched multi-day training parity.
+# ---------------------------------------------------------------------------
+
+N_DAYS, N_PASSES, B = 2, 3, 32
+
+
+def _simple_cfg():
+    return DataFeedConfig(slots=tuple(
+        [SlotConfig("label", dtype="float", is_dense=True, dim=1),
+         SlotConfig("dense0", dtype="float", is_dense=True, dim=3)]
+        + [SlotConfig(f"s{i}", slot_id=100 + i, capacity=CAP)
+           for i in range(4)]))
+
+
+def _simple_block(rng, n, n_keys=500):
+    blk = SlotRecordBlock(n=n)
+    for i in range(4):
+        lens = rng.integers(1, CAP + 1, size=n)
+        off = np.zeros((n + 1,), np.int64)
+        np.cumsum(lens, out=off[1:])
+        blk.uint64_slots[f"s{i}"] = (
+            rng.integers(1, n_keys, size=int(off[-1])).astype(np.uint64), off)
+    blk.float_slots["label"] = (rng.integers(0, 2, n).astype(np.float32),
+                                np.arange(n + 1, dtype=np.int64))
+    blk.float_slots["dense0"] = (
+        rng.normal(0, 1, n * 3).astype(np.float32),
+        np.arange(n + 1, dtype=np.int64) * 3)
+    return blk
+
+
+def _mk_ds(cfg, day, p):
+    ds = SlotDataset(cfg)
+    ds._blocks = [_simple_block(np.random.default_rng(100 * day + 10 * p),
+                                96)]
+    return ds
+
+
+def _day_keys(cfg):
+    parts = []
+    for day in range(N_DAYS):
+        for p in range(N_PASSES):
+            for b in _mk_ds(cfg, day, p).get_blocks():
+                parts.append(b.all_keys())
+    return np.unique(np.concatenate(parts))
+
+
+def _run_days(prefetch: bool, table=None):
+    """2 days x 3 passes of real DeepFM training; serial pass loop or the
+    PassPrefetcher driving the same deterministic per-pass datasets."""
+    cfg = _simple_cfg()
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=4, shard_num=4,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)), seed=0)
+    if table is not None:
+        eng.table = table
+    model = DeepFM(num_slots=4, emb_width=3 + 4, dense_dim=3, hidden=(8,))
+    tr = SparseTrainer(eng, model, cfg, batch_size=B, seed=0,
+                       sparse_path="fast")
+    losses = []
+    if not prefetch:
+        for day in range(N_DAYS):
+            eng.set_date(f"2026080{day + 1}")
+            for p in range(N_PASSES):
+                ds = _mk_ds(cfg, day, p)
+                eng.begin_feed_pass()
+                for b in ds.get_blocks():
+                    eng.add_keys(b.all_keys())
+                eng.end_feed_pass()
+                eng.begin_pass()
+                feed = tr.build_pass_feed(ds)
+                losses.append(tr.train_pass(feed)["loss"])
+                eng.end_pass()
+        return losses, eng, tr
+
+    pre = PassPrefetcher(eng, tr)
+    try:
+        for day in range(N_DAYS):
+            for p in range(N_PASSES):
+                def load(day=day, p=p):
+                    ds = _mk_ds(cfg, day, p)
+                    for b in ds.get_blocks():
+                        eng.add_keys(b.all_keys())
+                    return ds
+                pre.submit(load, tag=f"d{day}p{p}",
+                           date=f"2026080{day + 1}")
+        for _ in range(N_DAYS * N_PASSES):
+            feed = pre.next_pass()
+            losses.append(tr.train_pass(feed)["loss"])
+            pre.end_pass()          # wakes the worker's day-boundary gate
+    finally:
+        pre.close()
+    return losses, eng, tr
+
+
+def _assert_runs_identical(a, b, keys):
+    losses1, eng1, tr1 = a
+    losses2, eng2, tr2 = b
+    np.testing.assert_array_equal(np.asarray(losses1), np.asarray(losses2))
+    s1, s2 = eng1.table.bulk_pull(keys), eng2.table.bulk_pull(keys)
+    assert set(s1) == set(s2)
+    for f in s1:
+        np.testing.assert_array_equal(np.asarray(s1[f]), np.asarray(s2[f]),
+                                      err_msg=f"table field {f!r}")
+    import jax
+    for p1, p2 in zip(jax.tree_util.tree_leaves(tr1.params),
+                      jax.tree_util.tree_leaves(tr2.params)):
+        np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+
+
+def test_prefetched_day_loop_bit_identical():
+    """The whole pipelined path — worker-side feed/pull/pack against
+    peek_next_mapper, main-thread adopt+upload, day-boundary drain before
+    end_day decay — reproduces the serial loop exactly: same per-pass
+    losses, same model params, same final table, both days."""
+    keys = _day_keys(_simple_cfg())
+    _assert_runs_identical(_run_days(prefetch=False),
+                           _run_days(prefetch=True), keys)
+
+
+def test_prefetched_chaos_day_bit_identical():
+    """Pipelining composes with the exactly-once PS protocol: the same
+    2-day workflow against a remote table under seeded connection chaos
+    (drops + delays on client send/recv) converges bit-identically to the
+    fault-free serial run."""
+    from paddlebox_tpu.ps import faults
+    from paddlebox_tpu.ps.host_table import ShardedHostTable
+    from paddlebox_tpu.ps.service import PSClient, PSServer, \
+        RemoteTableAdapter
+
+    tcfg = EmbeddingTableConfig(embedding_dim=4, shard_num=4,
+                                sgd=SparseSGDConfig(mf_create_thresholds=0.0))
+    keys = _day_keys(_simple_cfg())
+    flags.set_flags({"ps_fault_injection": True})
+    srv1 = srv2 = None
+    try:
+        table1 = ShardedHostTable(tcfg, seed=0)
+        srv1 = PSServer(table1)
+        client1 = PSClient(srv1.addr, retries=None, retry_sleep=0.01,
+                           backoff_cap=0.1, deadline=60)
+        want = _run_days(prefetch=False,
+                         table=RemoteTableAdapter(client1, delta_mode=True))
+
+        table2 = ShardedHostTable(tcfg, seed=0)
+        srv2 = PSServer(table2)
+        client2 = PSClient(srv2.addr, retries=None, retry_sleep=0.01,
+                           backoff_cap=0.1, deadline=60)
+        faults.install(
+            faults.FaultPlan(seed=17)
+            .drop("send", role="client", prob=0.04)
+            .drop("recv", role="client", prob=0.03)
+            .delay("send", 0.002, role="client", prob=0.1))
+        got = _run_days(prefetch=True,
+                        table=RemoteTableAdapter(client2, delta_mode=True))
+        faults.uninstall()
+
+        losses1, _, tr1 = want
+        losses2, _, tr2 = got
+        np.testing.assert_array_equal(np.asarray(losses1),
+                                      np.asarray(losses2))
+        s1, s2 = table1.bulk_pull(keys), table2.bulk_pull(keys)
+        for f in s1:
+            np.testing.assert_array_equal(s1[f], s2[f],
+                                          err_msg=f"table field {f!r}")
+    finally:
+        faults.uninstall()
+        flags.set_flags({"ps_fault_injection": False})
+        for srv in (srv1, srv2):
+            if srv is not None:
+                srv.shutdown()
+
+
+def _write_slot_file(path, rng, n):
+    with open(path, "w") as f:
+        for _ in range(n):
+            parts = [f"1 {rng.integers(0, 2)}",
+                     "3 " + " ".join(f"{rng.normal():.4f}"
+                                     for _ in range(3))]
+            for _s in range(4):
+                k = rng.integers(1, CAP + 1)
+                parts.append(f"{k} " + " ".join(
+                    str(rng.integers(1, 500)) for _ in range(k)))
+            f.write(" ".join(parts) + "\n")
+
+
+def test_fleet_train_passes_parity(tmp_path):
+    """fleet.train_passes — the user-level day loop — trains identically
+    with the prefetcher on and off over real files."""
+    from paddlebox_tpu import fleet
+
+    cfg = _simple_cfg()
+    files = []
+    for p in range(2):
+        path = str(tmp_path / f"p{p}.txt")
+        _write_slot_file(path, np.random.default_rng(p), 64)
+        files.append([path])
+
+    def run(prefetch):
+        eng = BoxPSEngine(EmbeddingTableConfig(
+            embedding_dim=4, shard_num=4,
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0)), seed=0)
+        ds = fleet.BoxPSDataset(cfg, engine=eng, read_threads=1)
+        model = DeepFM(num_slots=4, emb_width=3 + 4, dense_dim=3,
+                       hidden=(8,))
+        tr = SparseTrainer(eng, model, cfg, batch_size=32, seed=0,
+                           sparse_path="fast")
+        return fleet.train_passes(tr, ds, files, date="20260801",
+                                  prefetch=prefetch)
+
+    m_serial, m_pipe = run(False), run(True)
+    assert len(m_serial) == len(m_pipe) == 2
+    np.testing.assert_array_equal([m["loss"] for m in m_serial],
+                                  [m["loss"] for m in m_pipe])
+    np.testing.assert_array_equal([m["batches"] for m in m_serial],
+                                  [m["batches"] for m in m_pipe])
+
+
+def test_prefetch_failure_surfaces_at_next_pass():
+    """A worker-side load failure must fail that next_pass loudly — never
+    silently train a stale working set."""
+    cfg = _simple_cfg()
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=4, shard_num=4,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+    model = DeepFM(num_slots=4, emb_width=3 + 4, dense_dim=3, hidden=(8,))
+    tr = SparseTrainer(eng, model, cfg, batch_size=B, seed=0,
+                       sparse_path="fast")
+
+    def boom():
+        raise OSError("filesystem went away")
+
+    with PassPrefetcher(eng, tr) as pre:
+        pre.submit(boom, tag="doomed")
+        with pytest.raises(RuntimeError, match="prefetch failed"):
+            pre.next_pass()
+
+
+# ---------------------------------------------------------------------------
+# Parallel-pack speedup floor (requires real cores).
+# ---------------------------------------------------------------------------
+
+def _usable_cpus() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:
+        return os.cpu_count() or 1
+
+
+@pytest.mark.skipif(_usable_cpus() < 4, reason="needs >= 4 usable cores")
+def test_parallel_pack_speedup_floor():
+    """At 4 threads the whole-pass pack must be >= 2x the single-thread
+    rate (best of 3 — pad/translate releases the GIL into numpy)."""
+    rng = np.random.default_rng(6)
+    cfg = DataFeedConfig(slots=tuple(
+        [SlotConfig("label", dtype="float", is_dense=True, dim=1),
+         SlotConfig("dense0", dtype="float", is_dense=True, dim=4)]
+        + [SlotConfig(f"s{i}", slot_id=100 + i, capacity=3)
+           for i in range(8)]))
+    blk = SlotRecordBlock(n=60_000)
+    n = blk.n
+    for i in range(8):
+        lens = rng.integers(1, 4, size=n)
+        off = np.zeros((n + 1,), np.int64)
+        np.cumsum(lens, out=off[1:])
+        blk.uint64_slots[f"s{i}"] = (
+            rng.integers(1, 200_000, size=int(off[-1])).astype(np.uint64),
+            off)
+    blk.float_slots["label"] = (rng.integers(0, 2, n).astype(np.float32),
+                                np.arange(n + 1, dtype=np.int64))
+    blk.float_slots["dense0"] = (
+        rng.normal(0, 1, n * 4).astype(np.float32),
+        np.arange(n + 1, dtype=np.int64) * 4)
+    keys = np.unique(np.concatenate(
+        [v[0] for v in blk.uint64_slots.values()]))
+    mapper = PassKeyMapper(keys[keys != 0])
+
+    def best(threads):
+        t = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            pf.pack_pass([blk], cfg, 4096, key_mapper=mapper,
+                         pack_threads=threads)
+            t = min(t, time.perf_counter() - t0)
+        return t
+
+    t1, t4 = best(1), best(4)
+    assert t1 / t4 >= 2.0, f"4-thread pack only {t1 / t4:.2f}x faster"

@@ -532,6 +532,88 @@ def test_pb403_class_attr_without_shutdown():
     assert codes(src) == ["PB403"]
 
 
+def test_pb405_unjoined_looping_thread():
+    src = """
+    import threading
+
+    class Pump:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            while True:
+                self.step()
+    """
+    # daemon= satisfies PB401; the unjoined recurring loop still trips 405
+    assert codes(src) == ["PB405"]
+
+
+def test_pb405_joined_thread_is_managed_lifecycle():
+    src = """
+    import threading
+
+    class Worker:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            while self.alive():
+                self.step()
+
+        def close(self):
+            self._t.join()
+    """
+    assert codes(src) == []
+
+
+def test_pb405_one_shot_target_not_flagged():
+    src = """
+    import threading
+
+    class Handoff:
+        def kick(self):
+            self._t = threading.Thread(target=self._build, daemon=True)
+            self._t.start()
+
+        def _build(self):
+            self.result = self.compute()
+    """
+    # no loop in the target: a one-shot handoff, not recurring work
+    assert codes(src) == []
+
+
+def test_pb405_unresolvable_target_skipped():
+    src = """
+    import threading
+
+    def serve(srv):
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+
+    def dynamic(fn):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+    """
+    # foreign receiver / dynamic callable: another object's lifecycle
+    assert codes(src) == []
+
+
+def test_pb405_anonymous_looping_thread():
+    src = """
+    import threading
+
+    def _loop():
+        while True:
+            pass
+
+    def fire():
+        threading.Thread(target=_loop, daemon=True).start()
+    """
+    assert codes(src) == ["PB405"]
+
+
 # -- suppressions ------------------------------------------------------------
 
 # -- PB5xx retry/backoff discipline ------------------------------------------
