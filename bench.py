@@ -396,6 +396,55 @@ def _pass_cycle(tag, dataset, engine, trainer, n_passes):
                 pipelined["feed_gap_ratio"] < serial["feed_gap_ratio"]}
 
 
+def _recovery_drill(tag, dataset, engine, trainer):
+    """Kill + resume in-process, clocking MTTR: time from simulated
+    trainer death to the first post-resume train step.  Checkpoints the
+    live table + dense state to a scratch generation root
+    (io/checkpoint.py), drops the engine's feed state on the floor (the
+    abrupt-death analogue), restores from the generation chain, and
+    re-drives one pass — the first completed batch stops the clock."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+    from paddlebox_tpu.io.checkpoint import TrainCheckpoint
+
+    if engine.ws is not None:       # close any live pass first
+        engine.end_pass()
+    root = _tempfile.mkdtemp(prefix="pbox-bench-ckpt-")
+    try:
+        ck = TrainCheckpoint(root)
+        t0 = time.perf_counter()
+        gen = ck.save(engine, trainer)
+        save_s = time.perf_counter() - t0
+
+        t_kill = time.perf_counter()
+        engine.reset_feed_state()   # the crashed run's in-flight state
+        ck.resume(engine, trainer)
+        restore_s = time.perf_counter() - t_kill
+
+        first = [None]
+
+        def progress(n):
+            if first[0] is None:
+                first[0] = time.perf_counter()
+            set_phase(f"{tag}:recovery-drill[batch {n}]", 300)
+
+        engine.begin_feed_pass()
+        for blk in dataset.get_blocks():
+            engine.add_keys(blk.all_keys())
+        engine.end_feed_pass()
+        engine.begin_pass()
+        feed = trainer.build_pass_feed(dataset)
+        trainer.train_pass(feed, progress=progress)
+        engine.end_pass()
+        t_first = first[0] or time.perf_counter()
+        return {"mttr_s": round(t_first - t_kill, 3),
+                "save_s": round(save_s, 3),
+                "restore_s": round(restore_s, 3),
+                "generation": int(gen)}
+    finally:
+        _shutil.rmtree(root, ignore_errors=True)
+
+
 def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     """One full bench at a given geometry.  Returns the results dict;
     records partials into _STATE as they are measured."""
@@ -587,8 +636,21 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
         except Exception as e:  # comparison is diagnostic, never fatal
             trace(f"{tag}: pass-cycle failed: {type(e).__name__}: {e}")
 
+    recovery = {}
+    if tag == "full" and not legacy \
+            and os.environ.get("BENCH_RECOVERY", "1") == "1":
+        set_phase(f"{tag}:recovery-drill", 600)
+        try:
+            recovery = _recovery_drill(tag, dataset, engine, trainer)
+            record(mttr_s=recovery["mttr_s"])
+            trace(f"{tag}: recovery drill mttr_s={recovery['mttr_s']:.3f} "
+                  f"(ckpt save {recovery['save_s']:.3f}s restore "
+                  f"{recovery['restore_s']:.3f}s gen {recovery['generation']})")
+        except Exception as e:  # drill is diagnostic, never fatal
+            trace(f"{tag}: recovery drill failed: {type(e).__name__}: {e}")
+
     return {"e2e": e2e_eps, "device_step": device_eps,
-            "pass_cycle": pass_cycle,
+            "pass_cycle": pass_cycle, "recovery": recovery,
             "batches": int(stats["batches"]), "examples": int(n_examples),
             "auc": round(float(stats.get("auc", float("nan"))), 4),
             "compile_s": round(compile_s, 1), "pass_pack_s": round(pack_s, 1),
@@ -674,7 +736,7 @@ def run() -> None:
          trim_frac=full["trim_frac"],
          device_busy_frac=full["device_busy_frac"],
          feed_gap_ratio=full["feed_gap_ratio"],
-         pass_cycle=full["pass_cycle"],
+         pass_cycle=full["pass_cycle"], recovery=full["recovery"],
          feed_intervals=full["feed_intervals"], timers=full["timers"],
          obs_stats=_obs_snapshot())
 
@@ -989,6 +1051,15 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
         if sfrac < -threshold:
             regressions.append(
                 f"pass_cycle.speedup {so:.2f} -> {sn:.2f} ({sfrac:+.1%})")
+    mo = num(old.get("recovery") or {}, "mttr_s")
+    mn = num(new.get("recovery") or {}, "mttr_s")
+    if mo and mn is not None:           # slower recovery = regression
+        mfrac = (mn - mo) / mo
+        out["mttr_s"] = {"old": mo, "new": mn,
+                         "delta_frac": round(mfrac, 4)}
+        if mfrac > threshold:
+            regressions.append(
+                f"recovery.mttr_s {mo:.3f} -> {mn:.3f} ({mfrac:+.1%})")
     oo = old.get("obs_stats") or {}
     on = new.get("obs_stats") or {}
     movers = []

@@ -233,6 +233,17 @@ class PassPrefetcher:
         self._ready.close()
         self._worker.join(timeout=30.0)
 
+    def abort(self) -> None:
+        """Crash-recovery teardown (fleet.train_passes' auto-resume tier):
+        stop + join the worker like :meth:`close`, then clear the ENGINE's
+        in-flight feed state — the worker may have died holding an open
+        feed window or an unadopted async build, and the checkpoint
+        restore that follows must start from a clean pass boundary
+        (pass_manager.BoxPSEngine.reset_feed_state)."""
+        self.close()
+        if hasattr(self.engine, "reset_feed_state"):
+            self.engine.reset_feed_state()
+
     def __enter__(self) -> "PassPrefetcher":
         return self
 

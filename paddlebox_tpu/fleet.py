@@ -182,7 +182,7 @@ def train_from_dataset(trainer: SparseTrainer, dataset: BoxPSDataset,
 def train_passes(trainer: SparseTrainer, dataset: BoxPSDataset,
                  passes: Sequence[Sequence[str]], date: Optional[str] = None,
                  before_pass=None, prefetch: Optional[bool] = None,
-                 ) -> list:
+                 checkpoint=None, resume=None) -> list:
     """Day loop over per-pass filelists — the reference's
     set_date/load_into_memory/begin_pass/train/end_pass sequence
     (dataset.py:1231 usage), pipelined when ``FLAGS_pass_prefetch`` is on:
@@ -195,46 +195,173 @@ def train_passes(trainer: SparseTrainer, dataset: BoxPSDataset,
     load, inside the pass's feed window — e.g.
     ``lambda ds: ds.preprocess_instance()`` for pv-grouped training.
     prefetch: override the flag (None = read FLAGS_pass_prefetch).
-    Returns the per-pass train metrics."""
+
+    Crash recovery (the production re-drive-by-date contract): pass a
+    ``TrainCheckpoint`` (or set ``FLAGS_ckpt_dir``) and an auto-resume
+    budget (``resume=N`` / True / ``FLAGS_auto_resume``) and the loop
+    (1) resumes from the last committed generation — completed passes of
+    the same ``date`` are SKIPPED via the checkpointed pass cursor,
+    (2) saves an incremental generation after every completed pass, and
+    (3) survives a mid-run failure with a two-tier retry: a write-back
+    ``ConnectionError`` re-drives ``end_pass`` in place (the pinned-rid
+    replay — chunks that landed dedup server-side), while a simulated
+    process death (faults.InjectedFault from a lifecycle kill site) or an
+    exhausted in-place retry tears the prefetcher down, reloads the last
+    generation (rolling back any partial pass) and re-drives the
+    remaining passes.  Bit-identity vs a fault-free run is asserted by
+    tests/test_crash_recovery.py.
+
+    Returns the per-pass train metrics; passes skipped by the resume
+    cursor (completed by a PREVIOUS incarnation) yield ``None`` entries
+    so indices still line up with ``passes``."""
     from paddlebox_tpu import flags as _flags
     from paddlebox_tpu.data.prefetch import PassPrefetcher
+    from paddlebox_tpu.ps import faults as _faults
+    from paddlebox_tpu.utils.backoff import Backoff as _Backoff
+    from paddlebox_tpu.utils.monitor import stat_add as _stat_add
+
     engine, ds = dataset.engine, dataset.dataset
-    if date is not None:
-        dataset.set_date(date)
     if prefetch is None:
         prefetch = bool(_flags.get_flags("pass_prefetch"))
-    metrics = []
-    if not prefetch:
-        for filelist in passes:
-            dataset.set_filelist(filelist)
+    if resume is None:
+        budget = int(_flags.get_flags("auto_resume"))
+    elif resume is True:
+        budget = int(_flags.get_flags("auto_resume")) or 8
+    else:
+        budget = int(resume)
+    if checkpoint is None:
+        root = _flags.get_flags("ckpt_dir")
+        if root:
+            from paddlebox_tpu.io.checkpoint import TrainCheckpoint
+            checkpoint = TrainCheckpoint(root)
+
+    # resume BEFORE set_date: the restored day cursor decides whether
+    # set_date triggers an end_day rollover (resuming into a new day) or
+    # is a same-day re-drive (skip completed passes)
+    state = None
+    if checkpoint is not None and budget > 0:
+        state = checkpoint.resume(engine, trainer)
+    start = 0
+    if state is not None and date is not None \
+            and state.get("day_id") == date:
+        start = min(int(state.get("pass_index", 0) or 0), len(passes))
+    if date is not None:
+        dataset.set_date(date)
+    if checkpoint is not None and budget > 0 and state is None:
+        # durable floor before the first pass: a crash after pass 0's
+        # write-back but before its generation commits must roll back TO
+        # something, or the re-driven pass double-applies
+        checkpoint.save(engine, trainer,
+                        extra={"day_id": engine.day_id, "pass_index": start})
+
+    metrics: list = [None] * start
+
+    def end_with_replay(end_fn) -> None:
+        # in-place tier: the server died (or dropped us) mid write-back
+        # while THIS trainer survived — engine/adapter state is intact, so
+        # re-driving end_pass resends byte-identical chunks under pinned
+        # rids (already-landed chunks dedup server-side).  The backoff
+        # window rides out a supervisor restart (launch.PSServerSupervisor)
+        bo = _Backoff(base=0.05, cap=2.0, deadline=30.0)
+        attempt = 0
+        while True:
+            try:
+                end_fn()
+                return
+            except _faults.InjectedFault:
+                raise       # simulated process death → outer resume tier
+            except ConnectionError:
+                attempt += 1
+                _stat_add("ps.fleet.end_pass_replay")
+                if not bo.sleep(attempt):
+                    raise
+
+    def save_cursor(i: int) -> None:
+        if checkpoint is not None:
+            checkpoint.save_pass(engine, trainer,
+                                 extra={"day_id": engine.day_id,
+                                        "pass_index": i + 1})
+
+    def run_serial(todo) -> None:
+        for i in todo:
+            dataset.set_filelist(passes[i])
             dataset.load_into_memory()
             if before_pass is not None:
                 before_pass(ds)
             dataset.begin_pass()
             feed = trainer.build_pass_feed(ds)
-            metrics.append(trainer.train_pass(feed))
-            dataset.end_pass()
-        return metrics
+            m = trainer.train_pass(feed)
+            end_with_replay(dataset.end_pass)
+            metrics.append(m)
+            save_cursor(i)
 
-    def load(filelist):
-        # runs on the prefetch worker INSIDE the feed window the
-        # prefetcher opened (begin_feed_pass is its job, not ours)
-        ds.set_filelist(filelist)
-        ds.load_into_memory()       # reader threads feed keys to engine
-        if before_pass is not None:
-            before_pass(ds)
-        return ds
+    def run_prefetch(todo) -> None:
+        def load(filelist):
+            # runs on the prefetch worker INSIDE the feed window the
+            # prefetcher opened (begin_feed_pass is its job, not ours)
+            ds.set_filelist(filelist)
+            ds.load_into_memory()   # reader threads feed keys to engine
+            if before_pass is not None:
+                before_pass(ds)
+            return ds
 
-    pf = PassPrefetcher(engine, trainer)
-    try:
-        for filelist in passes:
-            pf.submit(lambda fl=filelist: load(fl))
-        for _ in passes:
-            feed = pf.next_pass()
-            metrics.append(trainer.train_pass(feed))
-            # NOT dataset.end_pass(): its release_memory would drop the
-            # blocks the worker already loaded for the NEXT pass
-            pf.end_pass()
-    finally:
-        pf.close()
-    return metrics
+        pf = PassPrefetcher(engine, trainer)
+        try:
+            for i in todo:
+                pf.submit(lambda fl=passes[i]: load(fl))
+            for i in todo:
+                feed = pf.next_pass()
+                m = trainer.train_pass(feed)
+                # NOT dataset.end_pass(): its release_memory would drop
+                # the blocks the worker already loaded for the NEXT pass
+                end_with_replay(pf.end_pass)
+                metrics.append(m)
+                save_cursor(i)
+        except BaseException:
+            # failure path only: drop the pipeline AND the engine's
+            # in-flight feed state so the resume tier re-drives against a
+            # clean pass boundary (the happy path keeps feed state — the
+            # caller may chain more days onto this engine)
+            pf.abort()
+            raise
+        finally:
+            pf.close()
+
+    todo = list(range(start, len(passes)))
+    while True:
+        try:
+            if prefetch:
+                run_prefetch(todo)
+            else:
+                run_serial(todo)
+            return metrics
+        except (ConnectionError, RuntimeError):
+            if checkpoint is None or budget <= 0:
+                raise
+            budget -= 1
+            _stat_add("ps.fleet.auto_resume")
+            # roll the world back to the last committed generation: the
+            # partial pass's table writes (if any) are discarded with the
+            # reload, and the re-drive below replays it deterministically
+            if not prefetch:
+                if hasattr(engine, "reset_feed_state"):
+                    engine.reset_feed_state()
+            ds.release_memory()
+            state = checkpoint.resume(engine, trainer)
+            # the cursor only stands when the restored generation belongs
+            # to THE DAY THIS CALL DRIVES — a crash before the new day's
+            # first durable pass rolls the world back into the previous
+            # day, whose completed cursor must not skip the new passes
+            new_start = 0
+            if state is not None and date is not None \
+                    and state.get("day_id") == date:
+                new_start = min(int(state.get("pass_index", 0) or 0),
+                                len(passes))
+            if date is not None and engine.day_id != date:
+                # rolled back across the day boundary: re-drive set_date
+                # (end_day decay) exactly as the first attempt did —
+                # deterministic, since the table was rolled back with it
+                dataset.set_date(date)
+            del metrics[new_start:]
+            metrics.extend([None] * (new_start - len(metrics)))
+            todo = list(range(new_start, len(passes)))

@@ -1,17 +1,44 @@
-"""Checkpoint / resume orchestration.
+"""Checkpoint / resume orchestration — generation-chained and crash-safe.
 
 ≙ the reference's two-tier day/pass persistence (SURVEY.md §5): sparse
 SaveBase/SaveDelta + dense save_persistables, re-driven by date from ops
 scripts.  The rebuild adds what the reference lacked: a single
 ``TrainCheckpoint`` that atomically captures {dense params, optimizer state,
-metric state, day/pass cursor} next to the sparse table dump so a killed job
-resumes mid-day (`resume()` → last completed pass).
+day/pass cursor, server dedup window} next to the sparse table dump so a
+killed job resumes mid-day (`resume()` → last completed pass).
 
-Layout:
-  <root>/sparse/…            per-shard npz (ShardedHostTable.save mode=all)
-  <root>/dense.msgpack       flax-serialized params/opt_state pytree
-  <root>/STATE.json          {day_id, pass_id, step, auc_state?}
-  <root>/xbox/…              serving dump (save_xbox)
+Layout (immutable generations + one atomic pointer)::
+
+  <root>/MANIFEST.json        {"generation": n} — the ONLY mutable file,
+                              swapped via tmp+rename (_atomic_write)
+  <root>/gen-<n>/STATE.json   {generation, kind, chain, day_id, pass_id,
+                              phase, rows, ...extra}
+  <root>/gen-<n>/sparse/…     per-shard npz: the full table (kind=base)
+                              or just the rows the pass wrote (kind=delta)
+  <root>/gen-<n>/dense.msgpack flax-serialized params/opt_state pytree
+  <root>/xbox/…               serving dump (save_xbox)
+
+Crash-safety argument: a generation is assembled under ``gen-<n>.tmp``,
+renamed to ``gen-<n>``, and only THEN does MANIFEST advance.  A crash at
+any point leaves either the old MANIFEST pointing at a complete old
+generation (tmp/orphan dirs are ignored and reclaimed by the next save's
+GC) or the new MANIFEST pointing at a complete new one — there is no
+window in which no checkpoint loads (the old layout's rmtree-then-replace
+had exactly that window).
+
+Incremental cost: ``save_pass`` writes a *delta* generation holding only
+the rows the finished pass wrote (``engine._last_written``), so the
+per-pass cost is proportional to the pass delta, not the table.  Every
+``FLAGS_ckpt_every_passes`` generations the chain is compacted into a
+fresh base; ``FLAGS_ckpt_keep`` bounds retained history (retain-K GC
+never collects a generation a surviving chain still references).
+
+Resume walks the head generation's chain: load the base wholesale, then
+upsert each delta in order, then restore dense params + cursors from the
+head.  When the sparse save ran through a PSServer (RemoteTableAdapter),
+the server persisted its dedup window next to the shard files and the
+chain load restores it — exactly-once survives a server restart
+(ps/service.py).
 """
 
 from __future__ import annotations
@@ -21,15 +48,41 @@ import math
 import os
 import shutil
 import tempfile
+import time
 import warnings
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 import jax
 
 from flax import serialization
 
+from paddlebox_tpu import flags
+from paddlebox_tpu.ps import faults
 from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.utils import flight
+from paddlebox_tpu.utils.monitor import stat_add, stat_observe, stat_set
+
+flags.define_flag(
+    "ckpt_keep", 3,
+    "retain-K checkpoint GC: keep the newest K committed generations "
+    "(plus every older generation a surviving delta chain references)")
+flags.define_flag(
+    "ckpt_every_passes", 8,
+    "base-compaction cadence: after this many generations on one delta "
+    "chain, the next per-pass save writes a full base instead of a delta")
+flags.define_flag(
+    "auto_resume", 0,
+    "crash-recovery budget for fleet.train_passes: on a trainer-side "
+    "failure, roll back to the last committed generation and re-drive "
+    "the partial pass, at most this many times per call (0 disables)")
+flags.define_flag(
+    "ckpt_dir", "",
+    "default TrainCheckpoint root for fleet.train_passes — when set, "
+    "train_passes saves a delta generation after every pass and "
+    "auto-resume restores from here")
+
+MANIFEST = "MANIFEST.json"
 
 
 def _atomic_write(path: str, data: bytes) -> None:
@@ -40,54 +93,233 @@ def _atomic_write(path: str, data: bytes) -> None:
 
 
 class TrainCheckpoint:
-    def __init__(self, root: str):
+    """Generation-chained checkpoint store (see module docstring).
+
+    ``save``       full base generation (table mode="all" + dense + cursor)
+    ``save_pass``  incremental per-pass generation: delta rows only, with
+                   periodic base compaction
+    ``resume``     restore table (base + delta chain), dense, cursors
+    """
+
+    def __init__(self, root: str, keep: Optional[int] = None,
+                 base_every: Optional[int] = None):
         self.root = root
+        self.keep = max(1, int(flags.get_flags("ckpt_keep")
+                               if keep is None else keep))
+        self.base_every = max(1, int(flags.get_flags("ckpt_every_passes")
+                                     if base_every is None else base_every))
         os.makedirs(root, exist_ok=True)
 
-    def save(self, engine: BoxPSEngine, trainer, extra: Optional[Dict] = None
-             ) -> None:
-        """Capture engine table + trainer dense state + cursor."""
-        sparse_dir = os.path.join(self.root, "sparse.tmp")
-        if os.path.exists(sparse_dir):
-            shutil.rmtree(sparse_dir)
-        engine.table.save(sparse_dir, mode="all")
-        final = os.path.join(self.root, "sparse")
-        if os.path.exists(final):
-            shutil.rmtree(final)
-        os.replace(sparse_dir, final)
+    # -- layout helpers ------------------------------------------------------
+    def _gen_dir(self, n: int) -> str:
+        return os.path.join(self.root, f"gen-{n:06d}")
+
+    def _manifest(self) -> Optional[int]:
+        path = os.path.join(self.root, MANIFEST)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return int(json.load(f)["generation"])
+
+    def _state(self, n: int) -> Dict:
+        with open(os.path.join(self._gen_dir(n), "STATE.json")) as f:
+            return json.load(f)
+
+    def _committed(self) -> List[int]:
+        """Committed generation numbers ≤ the manifest head, ascending.
+        Orphans past the head (a crash between dir rename and pointer
+        swap) are excluded — they never became reachable."""
+        head = self._manifest()
+        if head is None:
+            return []
+        out = []
+        for name in os.listdir(self.root):
+            if not name.startswith("gen-") or name.endswith(".tmp"):
+                continue
+            try:
+                n = int(name[4:])
+            except ValueError:
+                continue
+            if n <= head and \
+                    os.path.exists(os.path.join(self.root, name,
+                                                "STATE.json")):
+                out.append(n)
+        return sorted(out)
+
+    # -- save ----------------------------------------------------------------
+    def save(self, engine: BoxPSEngine, trainer,
+             extra: Optional[Dict] = None) -> int:
+        """Full checkpoint: a new BASE generation.  Returns its number."""
+        return self._save_generation(engine, trainer, extra, kind="base")
+
+    def save_pass(self, engine: BoxPSEngine, trainer,
+                  extra: Optional[Dict] = None) -> int:
+        """Incremental end-of-pass checkpoint: a DELTA generation holding
+        only the rows the finished pass wrote (cost ∝ the pass delta).
+        Falls back to a base when there is no parent chain, when the
+        chain hit the compaction cadence, or when the engine has no
+        written-keys record yet."""
+        kind = "delta"
+        head = self._manifest()
+        keys = getattr(engine, "_last_written", None)
+        if head is None or keys is None or len(keys) == 0:
+            kind = "base"
+        else:
+            st = self._state(head)
+            chain = st.get("chain", [head])
+            # a day rollover (end_day) decays EVERY row but a delta only
+            # captures the pass's written rows — chaining across the
+            # boundary would roll untouched rows back to their undecayed
+            # previous-day values, so the first save of a new day is a
+            # full base
+            if st.get("day_id") != engine.day_id \
+                    or len(chain) >= self.base_every:
+                kind = "base"
+        return self._save_generation(engine, trainer, extra, kind=kind,
+                                     delta_keys=None if kind == "base"
+                                     else keys)
+
+    def _save_generation(self, engine: BoxPSEngine, trainer,
+                         extra: Optional[Dict], kind: str,
+                         delta_keys: Optional[np.ndarray] = None) -> int:
+        t0 = time.monotonic()
+        head = self._manifest()
+        gen = 0 if head is None else head + 1
+        if kind == "base" or head is None:
+            chain = [gen]
+        else:
+            chain = list(self._state(head).get("chain", [head])) + [gen]
+        tmpdir = self._gen_dir(gen) + ".tmp"
+        if os.path.exists(tmpdir):          # leftover of a crashed save
+            shutil.rmtree(tmpdir)
+        os.makedirs(tmpdir)
+
+        sparse_dir = os.path.join(tmpdir, "sparse")
+        if kind == "base":
+            rows = engine.table.save(sparse_dir, mode="all")
+        else:
+            rows = engine.table.save(sparse_dir, mode="rows",
+                                     keys=delta_keys)
+            stat_add("ckpt.delta_rows", float(rows))
+        if faults.ACTIVE is not None:
+            # mid-WAL kill point: sparse shard files are down but the
+            # generation is not yet assembled — a crash here must leave
+            # the previous generation loadable
+            faults.on_lifecycle("ckpt_sparse")
 
         dense = {
             "params": jax.device_get(trainer.params),
             "opt_state": jax.device_get(trainer.opt_state),
         }
-        _atomic_write(os.path.join(self.root, "dense.msgpack"),
-                      serialization.to_bytes(dense))
+        with open(os.path.join(tmpdir, "dense.msgpack"), "wb") as f:
+            f.write(serialization.to_bytes(dense))
 
-        state = {"day_id": engine.day_id, "pass_id": engine.pass_id,
-                 "phase": engine.phase}
+        state = {"generation": gen, "kind": kind, "chain": chain,
+                 "day_id": engine.day_id, "pass_id": engine.pass_id,
+                 "phase": engine.phase, "rows": int(rows)}
         if extra:
             state.update(extra)
-        _atomic_write(os.path.join(self.root, "STATE.json"),
-                      json.dumps(state).encode())
+        with open(os.path.join(tmpdir, "STATE.json"), "w") as f:
+            f.write(json.dumps(state))
+
+        final = self._gen_dir(gen)
+        if os.path.exists(final):
+            # an orphan from a crash between dir rename and pointer swap
+            # reused this number — it was never reachable, reclaim it
+            shutil.rmtree(final)
+        os.replace(tmpdir, final)
+        if faults.ACTIVE is not None:
+            # the crash window the MANIFEST swap closes: generation dir
+            # complete, pointer not yet advanced → old generation loads
+            faults.on_lifecycle("ckpt_commit")
+        _atomic_write(os.path.join(self.root, MANIFEST),
+                      json.dumps({"generation": gen}).encode())
+        dt = time.monotonic() - t0
+        stat_observe("ckpt.save_s", dt)
+        stat_set("ckpt.generation", float(gen))
+        flight.record("ckpt_commit", generation=gen, gen_kind=kind,
+                      rows=int(rows), chain_len=len(chain),
+                      save_s=round(dt, 3))
+        self._gc()
+        return gen
+
+    def _gc(self) -> None:
+        """Retain-K GC over committed generations: keep the newest
+        ``keep`` heads plus every generation their chains reference;
+        remove the rest (and stale .tmp assembly dirs)."""
+        committed = self._committed()
+        heads = committed[-self.keep:]
+        keep: set = set()
+        for h in heads:
+            keep.update(self._state(h).get("chain", [h]))
+        removed = []
+        for n in committed:
+            if n not in keep:
+                shutil.rmtree(self._gen_dir(n), ignore_errors=True)
+                removed.append(n)
+        for name in os.listdir(self.root):
+            if name.startswith("gen-") and name.endswith(".tmp"):
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+        if removed:
+            stat_add("ckpt.gc_removed", float(len(removed)))
+            flight.record("ckpt_gc", removed=len(removed),
+                          kept=len(keep))
+
+    # -- resume --------------------------------------------------------------
+    def load_table(self, table) -> Optional[int]:
+        """Table-only restore (the PSServerSupervisor's cross-process
+        reload path, launch.py): walk the head generation's chain into
+        ``table`` — base load, then delta upserts — without touching any
+        trainer state.  A server-side table also recovers its dedup
+        window here (the load verb restores DEDUP.bin, ps/service.py).
+        Returns the head generation number, or None when empty."""
+        head = self._manifest()
+        if head is None:
+            return None
+        chain = self._state(head).get("chain", [head])
+        table.load(os.path.join(self._gen_dir(chain[0]), "sparse"))
+        for n in chain[1:]:
+            table.load(os.path.join(self._gen_dir(n), "sparse"),
+                       mode="upsert")
+        return head
 
     def resume(self, engine: BoxPSEngine, trainer) -> Optional[Dict]:
-        """Restore everything; returns the cursor dict or None if no ckpt."""
-        state_path = os.path.join(self.root, "STATE.json")
-        if not os.path.exists(state_path):
+        """Restore everything from the newest committed generation (base
+        load + delta-chain upserts); returns the head STATE dict or None
+        when the root holds no checkpoint."""
+        head = self._manifest()
+        if head is None:
             return None
-        with open(state_path) as f:
-            state = json.load(f)
-        engine.table.load(os.path.join(self.root, "sparse"))
+        t0 = time.monotonic()
+        state = self._state(head)
+        chain = state.get("chain", [head])
+        flight.record("resume_begin", generation=head,
+                      chain_len=len(chain))
+        if hasattr(engine, "reset_feed_state"):
+            # abandon any half-open feed pass / pending working set from
+            # the crashed run before overwriting the table under it
+            engine.reset_feed_state()
+        engine.table.load(os.path.join(self._gen_dir(chain[0]), "sparse"))
+        for n in chain[1:]:
+            engine.table.load(os.path.join(self._gen_dir(n), "sparse"),
+                              mode="upsert")
         engine.day_id = state.get("day_id")
         engine.pass_id = state.get("pass_id", 0)
         engine.phase = state.get("phase", 1)
-        with open(os.path.join(self.root, "dense.msgpack"), "rb") as f:
+        with open(os.path.join(self._gen_dir(head), "dense.msgpack"),
+                  "rb") as f:
             dense = serialization.from_bytes(
                 {"params": jax.device_get(trainer.params),
                  "opt_state": jax.device_get(trainer.opt_state)},
                 f.read())
         trainer.params = dense["params"]
         trainer.opt_state = dense["opt_state"]
+        dt = time.monotonic() - t0
+        stat_observe("ckpt.restore_s", dt)
+        stat_set("ckpt.restore_gen", float(head))
+        flight.record("resume_ok", generation=head,
+                      pass_id=engine.pass_id, restore_s=round(dt, 3))
         return state
 
 
@@ -100,13 +332,17 @@ def save_xbox(engine: BoxPSEngine, path: str, base: bool = True) -> int:
     Row selection/masking is vectorized per shard and formatting runs in
     the native TSV writer (native/dump_writer.cc, ≙ the reference's
     native dump IO through PaddleFileMgr) with a per-row Python fallback.
+    The dump assembles under ``path + ".tmp"`` and renames into place so
+    a crashed dump never leaves a half-written file at the final path
+    (PB502 tmp+rename discipline).
     """
     from paddlebox_tpu.native import dump_writer
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     acc = engine.config.accessor
     qbits = engine.config.quant_bits
     n = 0
-    fh = None if dump_writer.available() else open(path, "w")
+    tmp_path = path + ".tmp"
+    fh = None if dump_writer.available() else open(tmp_path, "w")
     try:
         for shard in engine.table._shards:
             with shard.lock:
@@ -130,7 +366,7 @@ def save_xbox(engine: BoxPSEngine, path: str, base: bool = True) -> int:
                     scale = (1 << (qbits - 1)) - 1
                     mf = np.round(mf * scale) / scale
             if fh is None:
-                dump_writer.dump_rows(path, append=n > 0, keys=keys,
+                dump_writer.dump_rows(tmp_path, append=n > 0, keys=keys,
                                       show=show, click=click,
                                       embed_w=embed_w, mf=mf)
             else:
@@ -140,10 +376,11 @@ def save_xbox(engine: BoxPSEngine, path: str, base: bool = True) -> int:
                              f"{embed_w[i]:.6g}\t{vals}\n")
             n += len(idx)
         if fh is None and n == 0:
-            open(path, "w").close()     # empty dump still creates the file
+            open(tmp_path, "w").close()  # empty dump still creates the file
     finally:
         if fh is not None:
             fh.close()
+    os.replace(tmp_path, path)
     return n
 
 

@@ -55,6 +55,8 @@ class FileSystem:
             return f.read()
 
     def write_bytes(self, path: str, data: bytes) -> None:
+        # atomic publish is the caller's commit protocol (tmp + rename)
+        # pboxlint: disable-next=PB502 -- FS primitive, not a commit
         with self.open_write(path) as f:
             f.write(data)
 
@@ -74,6 +76,8 @@ class LocalFS(FileSystem):
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        # durable callers open a *.tmp name and commit via rename()
+        # pboxlint: disable-next=PB502 -- the write primitive itself
         return open(path, "wb")
 
     def exists(self, path: str) -> bool:

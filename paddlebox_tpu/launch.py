@@ -19,6 +19,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -360,6 +361,114 @@ def launch_elastic(script: str, script_args: List[str], nproc: int,
         hb_miss = {}
 
 
+class PSServerSupervisor:
+    """``--auto_resume``'s server half: own a PSServer, watch it, and
+    restart it in place when it dies (a chaos ``kill()``, an unhandled
+    crash) — the replica-watch of ``launch()`` pulled inside one process,
+    where the PS tier actually lives in tests and single-host jobs.
+
+    Restart semantics keep exactly-once intact: the new instance binds
+    the SAME port (clients retry through their backoff window and land on
+    it), shares the SAME table object, and receives the dead instance's
+    dedup window via ``PSServer(dedup_state=...)`` — so a client retrying
+    a ``push_sparse_delta`` that applied just before the kill replays the
+    cached response instead of double-applying.  With ``ckpt_root`` +
+    ``reload_from_ckpt=True`` the supervisor instead reloads the last
+    committed generation into the table before serving (cross-process
+    semantics: rows + DEDUP.bin from ONE checkpoint, io/checkpoint.py).
+
+    Bounded: ``max_restarts`` lifetime budget with exponential backoff
+    between attempts; bind retries ride out the dead listener's socket
+    lingering in TIME_WAIT.  ``stop()`` shuts the watch down and joins it
+    (the managed-lifecycle thread shape, lint rule PB405)."""
+
+    def __init__(self, table, host: str = "127.0.0.1", port: int = 0,
+                 max_restarts: int = 8, backoff_base: float = 0.05,
+                 backoff_cap: float = 1.0, ckpt_root: Optional[str] = None,
+                 reload_from_ckpt: bool = False, poll_s: float = 0.02):
+        from paddlebox_tpu.ps.service import PSServer
+        self._make = PSServer
+        self.table = table
+        self.host = host
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self.ckpt_root = ckpt_root
+        self.reload_from_ckpt = reload_from_ckpt
+        self._backoff = (backoff_base, backoff_cap)
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        self.server = PSServer(table, host=host, port=port)
+        self.port = self.server.addr[1]
+        self._watch = threading.Thread(target=self._run,
+                                       name="pbox-ps-supervisor",
+                                       daemon=True)
+        self._watch.start()
+
+    @property
+    def addr(self):
+        return (self.host, self.port)
+
+    def _restart(self) -> bool:
+        from paddlebox_tpu.utils.backoff import Backoff
+        from paddlebox_tpu.utils.monitor import stat_add, stat_set
+        old = self.server
+        self.restarts += 1
+        flight.record("resume_begin", role="ps_server",
+                      restart=self.restarts, port=self.port)
+        dedup = old.dedup_state()
+        if self.ckpt_root and self.reload_from_ckpt:
+            # cross-process restart semantics: distrust the in-process
+            # table and take rows AND dedup window from the same committed
+            # generation — a window entry for a rid whose write the reload
+            # rolled back would otherwise ack a retry without its data
+            from paddlebox_tpu.io.checkpoint import TrainCheckpoint
+            from paddlebox_tpu.ps.service import _dedup_read
+            ck = TrainCheckpoint(self.ckpt_root)
+            head = ck.load_table(self.table)
+            dedup = None
+            if head is not None:
+                dedup = _dedup_read(
+                    os.path.join(ck._gen_dir(head), "sparse"))
+        bo = Backoff(base=self._backoff[0], cap=self._backoff[1],
+                     deadline=30.0)
+        attempt = 0
+        while not self._stop.is_set():
+            try:
+                self.server = self._make(self.table, host=self.host,
+                                         port=self.port,
+                                         dedup_state=dedup)
+                break
+            except OSError:
+                # the dead listener's port may still be draining
+                attempt += 1
+                if not bo.sleep(attempt):
+                    return False
+        else:
+            return False
+        stat_add("ps.supervisor.restarts")
+        stat_set("ps.supervisor.restart_gen", float(self.restarts))
+        flight.record("resume_ok", role="ps_server",
+                      restart=self.restarts, port=self.port)
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self.server._dead:
+                if self.restarts >= self.max_restarts:
+                    flight.record("supervisor_give_up",
+                                  restarts=self.restarts)
+                    return
+                if not self._restart():
+                    return
+            self._stop.wait(self._poll_s)
+
+    def stop(self) -> None:
+        """Stop watching and shut the current server down (drain)."""
+        self._stop.set()
+        self._watch.join(timeout=30.0)
+        self.server.shutdown()
+
+
 def main():
     ap = argparse.ArgumentParser(prog="paddlebox_tpu.launch")
     ap.add_argument("--nproc_per_node", type=int, default=1)
@@ -406,6 +515,18 @@ def main():
                     help="pipeline the pass feed on every worker "
                          "(FLAGS_pass_prefetch): pass N+1's load/pull/"
                          "pack run in the background while pass N trains")
+    ap.add_argument("--auto_resume", type=int, default=0,
+                    help="crash-recovery budget (FLAGS_auto_resume): each "
+                         "worker's fleet.train_passes rolls back to the "
+                         "last committed checkpoint generation and "
+                         "re-drives the partial pass up to this many "
+                         "times; also floors --max_restarts so respawned "
+                         "workers actually get to resume.  0 = off")
+    ap.add_argument("--ckpt_dir", default="",
+                    help="checkpoint root for every worker "
+                         "(FLAGS_ckpt_dir): generation-chained saves "
+                         "after each pass + auto-resume restore from "
+                         "here (io/checkpoint.py)")
     ap.add_argument("--obs_port", type=int, default=0,
                     help="observability exporter base port: worker rank r "
                          "serves /metrics + /statz + /tracez + /flightz "
@@ -451,6 +572,16 @@ def main():
     if args.obs_postmortem_dir:
         # pboxlint: disable-next=PB203 -- env export to spawned workers
         os.environ["FLAGS_obs_postmortem_dir"] = args.obs_postmortem_dir
+    if args.auto_resume:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_auto_resume"] = str(args.auto_resume)
+        # a worker that dies outside train_passes (import crash, OOM)
+        # only resumes if the launcher respawns it: floor the respawn
+        # budget so --auto_resume alone yields a self-healing job
+        args.max_restarts = max(args.max_restarts, args.auto_resume)
+    if args.ckpt_dir:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_ckpt_dir"] = args.ckpt_dir
     proxy = None
     if args.chaos_backend:
         from paddlebox_tpu.ps.faults import ChaosProxy, FaultPlan

@@ -9,6 +9,7 @@ assigning stable ids used as replica-cache rows.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -100,11 +101,15 @@ class InputTable:
         return len(self._map)
 
     def save(self, path: str) -> None:
-        # dump must snapshot the map atomically vs concurrent resolve()
+        # dump must snapshot the map atomically vs concurrent resolve();
+        # write-tmp + os.replace so a crash mid-dump never leaves a torn
+        # file at the committed name (PB502 discipline)
+        tmp = path + ".tmp"
         # pboxlint: disable-next=PB104 -- save is a rare cold verb
-        with self._lock, open(path, "w") as f:
+        with self._lock, open(tmp, "w") as f:
             for k, v in self._map.items():
                 f.write(f"{k}\t{v}\n")
+        os.replace(tmp, path)
 
     def load(self, path: str) -> None:
         # load swaps the whole map; readers must not see a half-built one

@@ -5,11 +5,17 @@ dedup window + backoff-under-deadline) is only trustworthy if failures
 are *reproducible under test*.  This module provides that reproducibility
 two ways, both driven by one seedable :class:`FaultPlan`:
 
-  * **in-process hooks** at four named sites inside the service path —
-    ``connect`` (client about to dial), ``send`` / ``recv`` (either
-    peer's frame I/O), ``dispatch`` (server about to run a verb).  The
-    hooks can drop the connection, delay it, truncate a frame mid-write,
-    or kill the server abruptly mid-verb.  Production pays zero cost:
+  * **in-process hooks** at five named sites — ``connect`` (client about
+    to dial), ``send`` / ``recv`` (either peer's frame I/O), ``dispatch``
+    (server about to run a verb), and ``lifecycle`` (trainer-side
+    SIGKILL-schedule points: ``ckpt_sparse`` mid-checkpoint-write,
+    ``ckpt_commit`` between generation assembly and the MANIFEST pointer
+    swap, ``end_pass`` before the pass write-back — io/checkpoint.py and
+    ps/pass_manager.py fire them).  The hooks can drop the connection,
+    delay it, truncate a frame mid-write, kill the server abruptly
+    mid-verb, or simulate a process SIGKILL at a lifecycle point (the
+    kill-anywhere chaos soak's seeded schedule).  Production pays zero
+    cost:
     the service path checks one module global (``faults.ACTIVE``) that
     stays ``None`` unless :func:`install` ran, and ``install`` refuses
     unless the registered flag ``FLAGS_ps_fault_injection`` is set.
@@ -60,7 +66,9 @@ class InjectedFault(ConnectionError):
 
 @dataclasses.dataclass(frozen=True)
 class FaultAction:
-    kind: str                 # "drop" | "delay" | "truncate" | "kill_server"
+    # "drop" | "delay" | "truncate" | "kill_server" | "kill" (lifecycle
+    # site: simulate an abrupt process death at a named point)
+    kind: str
     delay_s: float = 0.0
 
 
@@ -111,7 +119,7 @@ class FaultPlan:
                  role: Optional[str] = None, at: Tuple[int, ...] = (),
                  prob: float = 0.0, limit: Optional[int] = None,
                  cmd: Optional[str] = None) -> "FaultPlan":
-        if site not in ("connect", "send", "recv", "dispatch"):
+        if site not in ("connect", "send", "recv", "dispatch", "lifecycle"):
             raise ValueError(f"unknown fault site {site!r}")
         with self._lock:
             self._rules.append(_Rule(site, role, action, tuple(at),
@@ -140,9 +148,24 @@ class FaultPlan:
                              limit, cmd)
 
     def kill_server(self, at: Tuple[int, ...] = (), prob: float = 0.0,
-                    cmd: Optional[str] = None) -> "FaultPlan":
+                    cmd: Optional[str] = None,
+                    limit: Optional[int] = 1) -> "FaultPlan":
+        """Abrupt server death mid-verb (dispatch site).  ``limit``
+        defaults to 1 for the single-restart soaks; the kill-anywhere
+        soak raises it and pairs each fire with a supervisor restart
+        (launch.PSServerSupervisor)."""
         return self.add_rule("dispatch", FaultAction("kill_server"),
-                             "server", at, prob, limit=1, cmd=cmd)
+                             "server", at, prob, limit=limit, cmd=cmd)
+
+    def kill_at(self, point: str, at: Tuple[int, ...] = (),
+                prob: float = 0.0,
+                limit: Optional[int] = None) -> "FaultPlan":
+        """Seeded SIGKILL schedule at a named lifecycle point
+        (``ckpt_sparse`` / ``ckpt_commit`` / ``end_pass``): the producer
+        site raises InjectedFault there, simulating an abrupt trainer
+        death whose kill points replay from this one plan/seed."""
+        return self.add_rule("lifecycle", FaultAction("kill"), None, at,
+                             prob, limit=limit, cmd=point)
 
     @classmethod
     def default_chaos(cls, seed: int = 0) -> "FaultPlan":
@@ -285,6 +308,27 @@ def on_dispatch(cmd: Optional[str], server) -> None:
         threading.Thread(target=server.kill, daemon=True).start()
         plan.killed.set()
         raise InjectedFault(f"injected: server killed mid-verb ({cmd})")
+
+
+def on_lifecycle(point: str) -> None:
+    """Trainer-side SIGKILL-schedule site: io/checkpoint.py fires it at
+    ``ckpt_sparse`` (shard files down, generation not assembled) and
+    ``ckpt_commit`` (generation assembled, MANIFEST not yet swapped);
+    ps/pass_manager.py fires ``end_pass`` before the pass write-back.
+    A matching ``kill`` rule raises InjectedFault — the abrupt-death
+    simulation the auto-resume path (fleet.train_passes) must survive."""
+    plan = ACTIVE
+    if plan is None:
+        return
+    act = plan.fire("lifecycle", None, point)
+    if act is None:
+        return
+    if act.kind == "delay":
+        time.sleep(act.delay_s)
+    elif act.kind in ("kill", "drop", "kill_server"):
+        plan.killed.set()
+        raise InjectedFault(f"injected: killed at lifecycle point "
+                            f"({point})")
 
 
 # ---------------------------------------------------------------------------

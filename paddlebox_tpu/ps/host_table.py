@@ -336,55 +336,84 @@ class ShardedHostTable:
 
     # -- persistence (≙ SaveBase/SaveDelta box_wrapper.cc:1286; per-shard
     #    files with .shard suffix, memory_sparse_table.h:34) ----------------
-    def save(self, path: str, mode: str = "base") -> int:
+    def save(self, path: str, mode: str = "base",
+             keys: Optional[np.ndarray] = None) -> int:
         """Per-shard npz dumps under `path`, which may be any registered
         filesystem scheme — e.g. hdfs://... through ShellFS
         (≙ SaveBase/SaveDelta's AFS paths, box_wrapper.h:721-743).  Shard
         files write in parallel on the pool; each lands atomically
         (tmp name + rename when the filesystem supports it), and delta
         mode resets ``delta_score`` only AFTER its shard file is safely
-        down — a mid-save filesystem failure can't lose deltas."""
+        down — a mid-save filesystem failure can't lose deltas.
+
+        mode="rows" saves exactly the rows of ``keys`` (missing keys are
+        skipped) — the checkpoint-delta primitive (io/checkpoint.py
+        generation chain): per-pass cost ∝ the pass's written key set,
+        and the resulting dump applies over a base via
+        ``load(path, mode="upsert")``."""
         from paddlebox_tpu.io import fs as pfs
         filesystem = pfs.get_fs(path)
         filesystem.mkdir(path)
         acc = self.config.accessor
+        if mode == "rows":
+            if keys is None:
+                raise ValueError("save(mode='rows') requires keys")
+            keys = np.asarray(keys, np.uint64)
+            row_sel = dict(self._shard_sel(keys))
 
         def save_shard(item) -> int:
             i, shard = item
             with shard.lock:
-                score = self._score(shard.soa)
-                if mode == "base":
-                    keep = score >= acc.base_threshold
-                elif mode == "delta":
-                    keep = np.abs(shard.soa["delta_score"]) \
-                        >= acc.delta_threshold
-                else:  # "all" / checkpoint
-                    keep = np.ones(shard.size, bool)
-                data = {f: arr[keep] for f, arr in shard.soa.items()}
-                data["keys"] = shard.keys[keep]
+                if mode == "rows":
+                    sel = row_sel.get(i)
+                    pos, found = (shard.lookup(keys[sel])
+                                  if sel is not None and len(sel)
+                                  else (np.zeros(0, np.int64),
+                                        np.zeros(0, bool)))
+                    idx = pos[found]
+                    data = {f: arr[idx] for f, arr in shard.soa.items()}
+                    data["keys"] = (keys[sel][found] if sel is not None
+                                    else np.zeros(0, np.uint64))
+                else:
+                    score = self._score(shard.soa)
+                    if mode == "base":
+                        keep = score >= acc.base_threshold
+                    elif mode == "delta":
+                        keep = np.abs(shard.soa["delta_score"]) \
+                            >= acc.delta_threshold
+                    else:  # "all" / checkpoint
+                        keep = np.ones(shard.size, bool)
+                    data = {f: arr[keep] for f, arr in shard.soa.items()}
+                    data["keys"] = shard.keys[keep]
                 part = f"{path.rstrip('/')}/part-{i:05d}.shard.npz"
                 try:
                     tmp = part + ".tmp"
-                    with filesystem.open_write(tmp) as fh:
-                        np.savez(fh, **data)
+                    with filesystem.open_write(tmp) as tmp_fh:
+                        np.savez(tmp_fh, **data)
                     filesystem.rename(tmp, part)
                 except NotImplementedError:
                     # scheme without a rename verb: direct write (the
                     # pre-atomic behavior; delta reset still gated on the
                     # write completing without raising)
+                    # pboxlint: disable-next=PB502 -- no rename verb here
                     with filesystem.open_write(part) as fh:
+                        # pboxlint: disable-next=PB502 -- same fallback
                         np.savez(fh, **data)
                 if mode == "delta":
                     # only now is the shard file known to have landed —
                     # zeroing before the write/rename could lose deltas
                     # to a mid-save failure
                     shard.soa["delta_score"][keep] = 0.0
-                return int(keep.sum())
+                return len(data["keys"])
 
         return sum(workpool.table_pool().map(
             save_shard, list(enumerate(self._shards))))
 
-    def load(self, path: str) -> int:
+    def load(self, path: str, mode: str = "replace") -> int:
+        """Read per-shard npz dumps.  mode="replace" (default) swaps each
+        shard's row set wholesale; mode="upsert" merges the dumped rows
+        over the current contents — the delta-chain apply of the
+        generation-chained checkpoint (io/checkpoint.py)."""
         from io import BytesIO
 
         from paddlebox_tpu.io import fs as pfs
@@ -428,11 +457,15 @@ class ShardedHostTable:
                         return arr.astype(tmpl.dtype) \
                             if arr.dtype != tmpl.dtype else arr
 
-                    shard.replace(new_keys,
-                                  {name: from_ckpt(name, tmpl)
-                                   for name, tmpl in shard.soa.items()})
+                    soa = {name: from_ckpt(name, tmpl)
+                           for name, tmpl in shard.soa.items()}
+                    if mode == "upsert":
+                        if n:
+                            shard.upsert(new_keys, soa)
+                    else:
+                        shard.replace(new_keys, soa)
             fh.close()
-            return shard.size
+            return n if mode == "upsert" else shard.size
 
         return sum(workpool.table_pool().map(
             load_shard, list(enumerate(self._shards))))

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from paddlebox_tpu import flags
 from paddlebox_tpu.config import EmbeddingTableConfig
 from paddlebox_tpu.parallel.topology import HybridTopology
-from paddlebox_tpu.ps import embedding
+from paddlebox_tpu.ps import embedding, faults
 from paddlebox_tpu.ps.host_table import ShardedHostTable
 from paddlebox_tpu.utils import flight, intervals, trace
 from paddlebox_tpu.utils.monitor import stat_add, stat_set, stat_snapshot
@@ -100,7 +100,9 @@ class BoxPSEngine:
         # pass prefetch, pass N+1's begin_feed_pass runs while pass N is
         # still training, and must not clobber N's open window.
         self._feed_obs0 = {
-            "stats0": stat_snapshot("ps."),
+            # ckpt.* rides along so the per-pass report can show this
+            # pass's checkpoint cost next to its wire/train phases
+            "stats0": {**stat_snapshot("ps."), **stat_snapshot("ckpt.")},
             "timers0": {n: (s, c) for n, s, c in self.timers.rows()},
             # feed-gap window anchor: end_pass computes the pass's
             # device_busy_frac / feed_gap_ratio over [here, write-back]
@@ -311,6 +313,11 @@ class BoxPSEngine:
         SAME write-back exactly-once (already-applied chunks dedup
         server-side)."""
         assert self.ws is not None and self.mapper is not None
+        if faults.ACTIVE is not None:
+            # chaos SIGKILL-schedule site: a seeded kill here simulates the
+            # trainer dying with a trained-but-unwritten pass — auto-resume
+            # must re-drive the pass from the last checkpoint
+            faults.on_lifecycle("end_pass")
         if embedding.is_quantized(self.ws):
             raise RuntimeError(
                 "serving-frozen working set cannot write back (its embedx "
@@ -367,6 +374,36 @@ class BoxPSEngine:
         if need_save_delta and delta_path:
             self.save_delta(delta_path)
 
+    def reset_feed_state(self) -> None:
+        """Drop every in-flight feed/pass artifact so a checkpoint restore
+        starts from a clean pass boundary (io/checkpoint.py resume, and
+        fleet.train_passes' auto-resume loop after a simulated trainer
+        death).  Joins a live async build first — its thread touches
+        ``_next``/``_build_error`` and must not race the reset — then
+        clears the working set, mapper, agent sink and the stale-row
+        cursor (the restored table already reflects the last durable
+        pass; replaying a stale ``_last_written`` would re-pull rows the
+        rollback discarded)."""
+        t = self._build_thread
+        if t is not None:
+            t.join(timeout=30)
+        # crash-recovery teardown: the only writer thread joined above
+        # pboxlint: disable-next=PB102 -- no concurrent builder remains
+        self._build_thread = None
+        self._build_error = None
+        self._next = None
+        with self._agent_lock:
+            self._agent_keys = []
+        # pboxlint: disable-next=PB102 -- single-coordinator lifecycle flag
+        self._feeding = False
+        self._feed_obs0 = None
+        self._pass_obs0 = None
+        self.ws = None
+        self.mapper = None
+        self.num_keys = 0
+        self._pulled_stats = None
+        self._last_written = None
+
     def freeze_for_serving(self, scale: float = 1.0 / 32767.0) -> None:
         """Re-encode the live working set's embedx as int16 for pull-only
         serving (≙ loading a quant-feature table + EmbedxQuantOp dequant,
@@ -419,7 +456,7 @@ class BoxPSEngine:
         obs0 = getattr(self, "_pass_obs0", None) or {}
         stats0 = obs0.get("stats0") or {}
         timers0 = obs0.get("timers0") or {}
-        cur = stat_snapshot("ps.")
+        cur = {**stat_snapshot("ps."), **stat_snapshot("ckpt.")}
 
         def delta(key: str) -> float:
             return cur.get(key, 0.0) - stats0.get(key, 0.0)
@@ -461,6 +498,16 @@ class BoxPSEngine:
         faults_n = sum(delta(k) for k in cur if k.startswith("ps.fault."))
         if faults_n:
             lines.append(f"  injected_faults={int(faults_n)}")
+        if delta("ckpt.save_s.count") > 0 or delta("ckpt.restore_s.count"):
+            # this pass paid checkpoint cost (generation-chained save at
+            # the pass boundary, or a crash-recovery restore mid-window)
+            lines.append(
+                f"  ckpt: saves={int(delta('ckpt.save_s.count'))} "
+                f"save_s={delta('ckpt.save_s.sum'):.3f} "
+                f"delta_rows={int(delta('ckpt.delta_rows'))} "
+                f"restores={int(delta('ckpt.restore_s.count'))} "
+                f"restore_s={delta('ckpt.restore_s.sum'):.3f} "
+                f"generation={int(cur.get('ckpt.generation', -1))}")
         rep = getattr(self, "_pass_feed_report", None)
         if rep:
             # interval-accounted utilization (utils/intervals.py): how

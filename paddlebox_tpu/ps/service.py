@@ -238,12 +238,87 @@ class _DedupWindow:
                 entries.pop(rid, None)
             self._cv.notify_all()
 
+    def export(self) -> List[Tuple[str, bytes]]:
+        """Durable snapshot of the window: every DONE entry as
+        (rid, wire-encoded response), in token/completion order.  In-flight
+        entries are deliberately skipped — their verbs never committed, so
+        a resend after restore re-executing them is exactly the correct
+        at-most-once-became-zero-times outcome.  Captured alongside the
+        table state it describes (the checkpoint's save verb / an
+        in-process ``PSServer.dedup_state`` handoff) so a client retrying
+        across a server death replays instead of double-applying."""
+        out: List[Tuple[str, bytes]] = []
+        with self._cv:
+            for entries in self._by_token.values():
+                for rid, entry in entries.items():
+                    if entry[0]:
+                        out.append((rid, wire.encode(entry[1])))
+        return out
+
+    def restore(self, state: List[Tuple[str, bytes]]) -> int:
+        """Full-replace the window from an ``export`` snapshot (restore
+        order follows the checkpoint chain, so the HEAD generation's
+        snapshot — restored last — wins).  Entries come back marked done;
+        eviction bookkeeping restarts fresh."""
+        with self._cv:
+            self._by_token.clear()
+            for rid, raw in state:
+                tok = self._token(rid)
+                entries = self._by_token.get(tok)
+                if entries is None:
+                    entries = self._by_token[tok] = OrderedDict()
+                entries[rid] = [True, wire.decode(raw)]
+            self._cv.notify_all()
+            return sum(len(e) for e in self._by_token.values())
+
 
 # verbs whose rid is an ECHO ONLY (response matching on pipelined
 # streams), never a dedup-window entry: they are idempotent, and caching
 # e.g. a bulk pull response would blow the window's bounded memory
 _RID_ECHO_ONLY = frozenset({"pull_sparse", "pull_dense", "size",
                             "list_tables", "health", "save", "load"})
+
+# dedup-window snapshot rides in the checkpointed sparse dir, next to the
+# shard files it must stay consistent with
+DEDUP_FILE = "DEDUP.bin"
+
+
+def _dedup_dump(path: str, state: List[Tuple[str, bytes]]) -> None:
+    """Write a dedup-window snapshot as length-prefixed records
+    ([rid_len][rid utf8][resp_len][wire-encoded resp]...) via tmp+rename —
+    a crash mid-write leaves the previous file (or none) intact."""
+    final = os.path.join(path, DEDUP_FILE)
+    tmp = final + ".tmp"
+    with open(tmp, "wb") as fh:
+        for rid, raw in state:
+            rb = rid.encode("utf-8")
+            fh.write(struct.pack("<Q", len(rb)))
+            fh.write(rb)
+            fh.write(struct.pack("<Q", len(raw)))
+            fh.write(raw)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, final)
+
+
+def _dedup_read(path: str) -> Optional[List[Tuple[str, bytes]]]:
+    fname = os.path.join(path, DEDUP_FILE)
+    if not os.path.exists(fname):
+        return None
+    out: List[Tuple[str, bytes]] = []
+    with open(fname, "rb") as fh:
+        buf = fh.read()
+    off = 0
+    while off < len(buf):
+        (rl,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        rid = buf[off:off + rl].decode("utf-8")
+        off += rl
+        (bl,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        out.append((rid, buf[off:off + bl]))
+        off += bl
+    return out
 
 
 class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
@@ -263,7 +338,8 @@ class PSServer:
 
     def __init__(self, table: Union[ShardedHostTable,
                                     Dict[str, ShardedHostTable]],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 dedup_state: Optional[List[Tuple[str, bytes]]] = None):
         if isinstance(table, dict):
             self.tables: Dict[str, ShardedHostTable] = dict(table)
         else:
@@ -282,6 +358,13 @@ class PSServer:
         self._reduce_cv = threading.Condition()
         self._reduces: Dict[str, Dict] = {}
         self._dedup = _DedupWindow(cap=flags.get_flags("ps_dedup_window"))
+        if dedup_state:
+            # restart-durable exactly-once: a supervisor restarting a dead
+            # server hands the old instance's window over (the table object
+            # survived in-process, so state + window stay consistent)
+            n = self._dedup.restore(dedup_state)
+            stat_add("ps.server.dedup_restore_entries", n)
+            flight.record("dedup_restore", entries=n, source="handoff")
         # lifecycle: _life_lock guards the dead flag (shutdown/kill may
         # race from a fault hook thread); _inflight_cv counts verbs being
         # executed so a graceful drain can wait them out
@@ -535,10 +618,28 @@ class PSServer:
                     self.dense[req["name"]] = req["value"]
             return {"ok": True}
         if cmd == "save":
-            n = self._table(req).save(req["path"], req.get("mode", "all"))
+            keys = req.get("keys")
+            if keys is not None:
+                n = self._table(req).save(req["path"],
+                                          req.get("mode", "all"), keys=keys)
+            else:
+                n = self._table(req).save(req["path"],
+                                          req.get("mode", "all"))
+            # the dedup window is PART of the table's durable state: a
+            # checkpoint that restored rows without the rids that wrote
+            # them would double-apply a client's post-restart retry
+            _dedup_dump(req["path"], self._dedup.export())
             return {"ok": True, "saved": n}
         if cmd == "load":
-            return {"ok": True, "loaded": self._table(req).load(req["path"])}
+            n = self._table(req).load(req["path"],
+                                      req.get("mode", "replace"))
+            state = _dedup_read(req["path"])
+            if state is not None:
+                restored = self._dedup.restore(state)
+                stat_add("ps.server.dedup_restore_entries", restored)
+                flight.record("dedup_restore", entries=restored,
+                              source="checkpoint")
+            return {"ok": True, "loaded": n}
         if cmd == "shrink":
             return {"ok": True, "removed": self._table(req).shrink()}
         if cmd == "end_day":
@@ -673,14 +774,23 @@ class PSServer:
         """Abrupt death (the chaos harness's mid-verb server loss): no
         drain — the listener and every live connection drop on the floor.
         Table state survives in-process; a restart on the same port
-        resumes service (the dedup window does NOT survive — exactly-once
-        across a kill holds because injected kills fire before the verb
-        applies)."""
+        resumes service.  Exactly-once survives the kill two ways: an
+        in-process restart hands ``dedup_state()`` to the new instance
+        (launch.PSServerSupervisor), and a cross-process restart reloads
+        the window from the checkpoint's DEDUP.bin alongside the rows it
+        describes.  Injected mid-verb kills additionally fire BEFORE the
+        verb applies (crash-before-commit)."""
         if not self._mark_dead():
             return
         self._srv.shutdown()
         self._srv.server_close()
         self._close_conns()
+
+    def dedup_state(self) -> List[Tuple[str, bytes]]:
+        """Snapshot the dedup window for an in-process restart handoff:
+        ``PSServer(table, port=old_port, dedup_state=old.dedup_state())``.
+        Safe to call on a dead server (the window outlives the sockets)."""
+        return self._dedup.export()
 
     def _close_conns(self) -> None:
         with self._conns_lock:
@@ -1358,12 +1468,15 @@ class PSClient:
                     "value": np.asarray(value), "add": add}, dedup=True)
 
     def save(self, path: str, mode: str = "all",
-             table: Optional[str] = None) -> int:
-        return self._call({"cmd": "save", "path": path, "mode": mode,
-                           "table": table})["saved"]
+             table: Optional[str] = None, keys=None) -> int:
+        req = {"cmd": "save", "path": path, "mode": mode, "table": table}
+        if keys is not None:
+            req["keys"] = np.asarray(keys, np.uint64)
+        return self._call(req)["saved"]
 
-    def load(self, path: str, table: Optional[str] = None) -> int:
-        return self._call({"cmd": "load", "path": path,
+    def load(self, path: str, table: Optional[str] = None,
+             mode: str = "replace") -> int:
+        return self._call({"cmd": "load", "path": path, "mode": mode,
                            "table": table})["loaded"]
 
     def shrink(self, table: Optional[str] = None) -> int:
@@ -1539,11 +1652,11 @@ class RemoteTableAdapter:
     def shrink(self):
         return self.client.shrink(table=self.table)
 
-    def save(self, path, mode="all"):
-        return self.client.save(path, mode, table=self.table)
+    def save(self, path, mode="all", keys=None):
+        return self.client.save(path, mode, table=self.table, keys=keys)
 
-    def load(self, path):
-        return self.client.load(path, table=self.table)
+    def load(self, path, mode="replace"):
+        return self.client.load(path, table=self.table, mode=mode)
 
     def size(self):
         return self.client.size(table=self.table)

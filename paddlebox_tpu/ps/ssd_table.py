@@ -70,8 +70,11 @@ class SSDShard:
         for j, f in enumerate(self.scalar_fields):
             rec["v"][:, j] = soa[f]
         rec["v"][:, len(self.scalar_fields):] = soa["mf"]
-        # the log file IS the locked resource: append offset + index
-        # pboxlint: disable-next=PB104 -- update must be atomic vs compact
+        # the log file IS the locked resource: append offset + index.
+        # PB502: append-only WAL — a torn tail is invisible because the
+        # in-memory index only advances after the write returns, and
+        # tmp+rename cannot express an append
+        # pboxlint: disable-next=PB104,PB502 -- atomic vs compact; WAL
         with self._lock, open(self.path, "ab") as fh:
             off0 = fh.tell()
             fh.write(rec.tobytes())

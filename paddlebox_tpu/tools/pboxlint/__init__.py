@@ -13,6 +13,8 @@ interpreter, so this package supplies the equivalent as lint passes over
   PB3xx  JAX purity             (tools/pboxlint/purity.py)
   PB4xx  threading lifecycle    (tools/pboxlint/lifecycle.py)
   PB5xx  retry/backoff          (tools/pboxlint/retries.py)
+         + durable-write atomicity, PB502
+           (tools/pboxlint/atomic_io.py)
 
 CLI::
 

@@ -1,0 +1,104 @@
+"""PB5xx — durable-write discipline (the atomic-rename rule).
+
+  PB502  a bare write sink (``open(path, "wb")``, ``np.savez(path)``,
+         ``fs.open_write(path)``) targeting a FINAL path inside
+         checkpoint/dump code.  A crash mid-write leaves a torn file at
+         the committed name — the exact corruption the generation-chain
+         protocol (io/checkpoint.py) exists to rule out.  Durable
+         artifacts must be written to a scratch path and published with
+         ``os.replace`` (write-tmp + fsync + rename), so the committed
+         name only ever points at a complete file.
+
+         Scope: calls inside a function whose name mentions
+         save/dump/checkpoint/persist/write_… or anywhere in an ``io/``
+         module — ad-hoc writes elsewhere (test fixtures, debug dumps)
+         are not durability-critical.  A sink whose path expression
+         mentions ``tmp`` (``path + ".tmp"``, ``tmp_path``, a
+         ``mkstemp``/``TemporaryDirectory`` product) IS the scratch leg
+         of the protocol and is never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
+                                               PackageContext, dotted_name)
+
+_WRITE_MODES = set("wax")
+_FUNC_HINTS = ("save", "dump", "checkpoint", "persist", "write")
+
+
+def _path_arg(node: ast.Call) -> Optional[ast.AST]:
+    if node.args:
+        return node.args[0]
+    for kw in node.keywords:
+        if kw.arg in ("file", "path", "filename"):
+            return kw.value
+    return None
+
+
+def _is_tmp_path(arg: Optional[ast.AST]) -> bool:
+    """The sink already targets a scratch name: its path expression
+    mentions tmp (``path + ".tmp"``, ``tmp_dir``, tempfile products)."""
+    if arg is None:
+        return False
+    try:
+        return "tmp" in ast.unparse(arg).lower()
+    except Exception:
+        return False
+
+
+def _sink(node: ast.Call) -> Optional[str]:
+    """Classify a call as a final-path write sink; None when it isn't."""
+    name = dotted_name(node.func)
+    if name == "open":
+        for i, arg in enumerate(node.args[:2]):
+            if i == 1 and isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str) \
+                    and _WRITE_MODES & set(arg.value):
+                return f'open(..., "{arg.value}")'
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str) \
+                    and _WRITE_MODES & set(kw.value.value):
+                return f'open(..., mode="{kw.value.value}")'
+        return None
+    tail = name.rsplit(".", 1)[-1] if name else ""
+    if tail in ("savez", "savez_compressed") or name in ("np.save",
+                                                         "numpy.save"):
+        return name
+    if tail == "open_write":
+        return name
+    return None
+
+
+def _durable_context(mod: Module, func_stack: List[str]) -> bool:
+    if "/io/" in mod.path.replace("\\", "/"):
+        return True
+    return any(any(h in fn.lower() for h in _FUNC_HINTS)
+               for fn in func_stack)
+
+
+def check(mod: Module, ctx: PackageContext) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, stack: List[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack = stack + [node.name]
+        if isinstance(node, ast.Call):
+            sink = _sink(node)
+            if sink is not None and _durable_context(mod, stack) \
+                    and not _is_tmp_path(_path_arg(node)):
+                findings.append(Finding(
+                    mod.path, node.lineno, "PB502",
+                    f"bare write sink {sink} at a final path in "
+                    "checkpoint/dump code: a crash mid-write leaves a "
+                    "torn file at the committed name — write to a "
+                    "*.tmp scratch path and publish with os.replace"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack)
+
+    visit(mod.tree, [])
+    return findings

@@ -118,8 +118,10 @@ def write_postmortem(reason: str = "", directory: Optional[str] = None) -> str:
     path = os.path.join(
         d, f"postmortem-{os.getpid()}-{int(time.time() * 1000)}.json")
     bundle = dump_state(reason=reason)
-    with open(path, "w") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(bundle, f, indent=1, default=str)
+    os.replace(tmp, path)
     flight.record("postmortem_written", path=path, reason=reason)
     return path
 

@@ -18,6 +18,9 @@ Producers record rare, meaningful lifecycle events:
   pool saturation            utils/workpool.py (new queue-depth hwm only)
   elastic grow/shrink        launch.py
   checkpoint save/load       ps/pass_manager.py, io/checkpoint.py
+  ckpt commit / gc           io/checkpoint.py (generation chain)
+  resume begin / ok          io/checkpoint.py, launch.py (supervisor)
+  dedup restore              ps/service.py (checkpoint / restart handoff)
   bench phases / wedges      bench.py
 
 Consumers: ``/flightz`` on the obs exporter (utils/obs_server.py), the

@@ -689,6 +689,75 @@ def test_pb501_suppression_escape():
     assert codes(src) == []
 
 
+# -- PB502 durable-write atomicity -------------------------------------------
+
+def test_pb502_bare_open_in_save_function():
+    src = """
+    import json
+
+    def save_manifest(path, obj):
+        with open(path, "w") as f:
+            json.dump(obj, f)
+    """
+    assert codes(src) == ["PB502"]
+
+
+def test_pb502_savez_and_open_write_in_checkpoint_code():
+    src = """
+    import numpy as np
+
+    def dump_shard(fs, part, data):
+        np.savez(part, **data)
+        with fs.open_write(part) as fh:
+            fh.write(b"x")
+    """
+    assert codes(src) == ["PB502", "PB502"]
+
+
+def test_pb502_io_module_scope():
+    # under io/ every bare final-path write is durability-critical,
+    # whatever the function is called
+    src = """
+    def publish(path, blob):
+        with open(path, "wb") as f:
+            f.write(blob)
+    """
+    assert codes(src, path="paddlebox_tpu/io/artifacts.py") == ["PB502"]
+
+
+def test_pb502_negative_tmp_path_and_cold_code():
+    # the scratch leg of write-tmp-then-rename is the SANCTIONED pattern;
+    # reads and writes outside save/dump/io code are out of scope
+    src = """
+    import os
+
+    def save_table(path, blob):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
+    def load_table(path):
+        with open(path, "rb") as f:
+            return f.read()
+
+    def debug_note(path, msg):
+        with open(path, "a") as f:
+            f.write(msg)
+    """
+    assert codes(src) == []
+
+
+def test_pb502_suppression_escape():
+    src = """
+    def save_wal(path, rec):
+        # pboxlint: disable-next=PB502 -- append-only WAL, index-gated
+        with open(path, "ab") as f:
+            f.write(rec)
+    """
+    assert codes(src) == []
+
+
 def test_suppression_same_line_and_next_line():
     base = """
     import threading
