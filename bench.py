@@ -52,7 +52,9 @@ BENCH_PACK_THREADS, BENCH_SKIP_SMOKE=1, BENCH_SMOKE_ONLY=1,
 BENCH_LEGACY_FEED=1 (per-batch host pack path), BENCH_STEP_PROFILE=0,
 BENCH_BACKEND_ATTEMPT_S (per-attempt backend-init window, default 150),
 BENCH_NO_SUPERVISE=1 (single-process debug mode),
-BENCH_COMPARE_THRESHOLD (default regression threshold for --compare).
+BENCH_COMPARE_THRESHOLD (default regression threshold for --compare),
+BENCH_CACHE=0 (skip the device-cache on/off compare),
+BENCH_CACHE_PASSES/_KEYS/_DRAWS/_ROWS (cache-compare geometry).
 """
 
 import json
@@ -445,6 +447,80 @@ def _recovery_drill(tag, dataset, engine, trainer):
         _shutil.rmtree(root, ignore_errors=True)
 
 
+def _cache_compare(tag):
+    """Same-process device-cache on/off comparison over a zipf-skewed key
+    stream (the production shape: a small hot set dominates every pass).
+
+    Two fresh engines — the cache flag is read at engine construction —
+    drive the same pass-cycle key feed (begin_feed_pass -> add_keys ->
+    end_feed_pass -> begin_pass -> end_pass) over IDENTICAL key blocks.
+    No trainer: the cache lives entirely on the pull/fold-back path, so
+    engine-level cycles isolate exactly what the HBM tier buys — wire
+    rows that never leave the host table.  Steady-state numbers exclude
+    the all-miss cold first pass (stat deltas from pass 2 on)."""
+    from paddlebox_tpu import flags
+    from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+    from paddlebox_tpu.utils.monitor import stat_snapshot
+
+    n_passes = int(os.environ.get("BENCH_CACHE_PASSES", 6))
+    n_keys = int(os.environ.get("BENCH_CACHE_KEYS", 100_000))
+    draws = int(os.environ.get("BENCH_CACHE_DRAWS", 262_144))
+    cap = int(os.environ.get("BENCH_CACHE_ROWS", 65_536))
+
+    rng = np.random.default_rng(7)
+    blocks = [np.minimum(rng.zipf(1.3, size=draws), n_keys)
+              .astype(np.uint64) for _ in range(n_passes)]
+
+    def cycle(on):
+        def delta(key):
+            return (stat_snapshot("ps.").get(key, 0.0)
+                    - warm.get(key, 0.0))
+
+        flags.set_flags({"ps_device_cache": bool(on),
+                         "ps_device_cache_rows": cap})
+        engine = BoxPSEngine(EmbeddingTableConfig(
+            embedding_dim=8, shard_num=8,
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0)))
+        warm = {}
+        t0 = time.perf_counter()
+        for p in range(n_passes):
+            set_phase(f"{tag}:cache-compare:{'on' if on else 'off'}"
+                      f"[pass {p + 1}/{n_passes}]", 300)
+            engine.begin_feed_pass()
+            engine.add_keys(blocks[p])
+            engine.end_feed_pass()
+            engine.begin_pass()
+            engine.end_pass()
+            if p == 0:      # steady-state basis: skip the cold pass
+                warm = stat_snapshot("ps.")
+        wall = time.perf_counter() - t0
+        out = {"wall_s": round(wall, 1),
+               "wire_rows": int(delta("ps.engine.build_pull_rows"))}
+        if on:
+            hits, misses = delta("ps.cache.hits"), delta("ps.cache.misses")
+            out.update(
+                hits=int(hits), misses=int(misses),
+                hit_rate=round(hits / max(hits + misses, 1.0), 4),
+                wire_bytes_saved=int(delta("ps.cache.bytes_saved")),
+                evictions=int(delta("ps.cache.evictions")))
+        return out
+
+    prev = {k: flags.get_flags(k)
+            for k in ("ps_device_cache", "ps_device_cache_rows")}
+    try:
+        off = cycle(False)
+        on = cycle(True)
+    finally:
+        flags.set_flags(prev)
+    reduction = off["wire_rows"] / max(on["wire_rows"], 1)
+    return {"off": off, "on": on, "passes": n_passes,
+            "cache_rows": cap, "zipf_a": 1.3,
+            "hit_rate": on["hit_rate"],
+            "wire_bytes_saved": on["wire_bytes_saved"],
+            "wire_reduction": round(reduction, 2)}
+
+
 def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     """One full bench at a given geometry.  Returns the results dict;
     records partials into _STATE as they are measured."""
@@ -649,8 +725,29 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
         except Exception as e:  # drill is diagnostic, never fatal
             trace(f"{tag}: recovery drill failed: {type(e).__name__}: {e}")
 
+    cache_cmp = {}
+    if tag == "full" and not legacy \
+            and os.environ.get("BENCH_CACHE", "1") == "1":
+        set_phase(f"{tag}:cache-compare", 600)
+        try:
+            cache_cmp = _cache_compare(tag)
+            record(cache_hit_rate=cache_cmp["hit_rate"],
+                   cache_wire_reduction=cache_cmp["wire_reduction"])
+            trace(f"{tag}: cache-compare hit_rate="
+                  f"{cache_cmp['hit_rate']:.3f} wire_rows "
+                  f"{cache_cmp['off']['wire_rows']:,} -> "
+                  f"{cache_cmp['on']['wire_rows']:,} "
+                  f"({cache_cmp['wire_reduction']:.2f}x reduction, "
+                  f"{cache_cmp['wire_bytes_saved'] / 1e6:.1f} MB saved)")
+            if cache_cmp["wire_reduction"] < 2.0:
+                trace(f"{tag}: WARNING cache wire-row reduction below the "
+                      "2x acceptance floor on the zipf workload")
+        except Exception as e:  # comparison is diagnostic, never fatal
+            trace(f"{tag}: cache-compare failed: {type(e).__name__}: {e}")
+
     return {"e2e": e2e_eps, "device_step": device_eps,
             "pass_cycle": pass_cycle, "recovery": recovery,
+            "cache": cache_cmp,
             "batches": int(stats["batches"]), "examples": int(n_examples),
             "auc": round(float(stats.get("auc", float("nan"))), 4),
             "compile_s": round(compile_s, 1), "pass_pack_s": round(pack_s, 1),
@@ -737,6 +834,7 @@ def run() -> None:
          device_busy_frac=full["device_busy_frac"],
          feed_gap_ratio=full["feed_gap_ratio"],
          pass_cycle=full["pass_cycle"], recovery=full["recovery"],
+         cache=full["cache"],
          feed_intervals=full["feed_intervals"], timers=full["timers"],
          obs_stats=_obs_snapshot())
 
@@ -1051,6 +1149,24 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
         if sfrac < -threshold:
             regressions.append(
                 f"pass_cycle.speedup {so:.2f} -> {sn:.2f} ({sfrac:+.1%})")
+    co, cn = old.get("cache") or {}, new.get("cache") or {}
+    ho, hn = num(co, "hit_rate"), num(cn, "hit_rate")
+    if ho and hn is not None:           # lower cache hit rate = regression
+        hfrac = (hn - ho) / ho
+        out["cache_hit_rate"] = {"old": ho, "new": hn,
+                                 "delta_frac": round(hfrac, 4)}
+        if hfrac < -threshold:
+            regressions.append(
+                f"cache.hit_rate {ho:.3f} -> {hn:.3f} ({hfrac:+.1%})")
+    wo, wn = num(co, "wire_reduction"), num(cn, "wire_reduction")
+    if wo and wn is not None:           # less wire saved = regression
+        wfrac = (wn - wo) / wo
+        out["cache_wire_reduction"] = {"old": wo, "new": wn,
+                                       "delta_frac": round(wfrac, 4)}
+        if wfrac < -threshold:
+            regressions.append(
+                f"cache.wire_reduction {wo:.2f}x -> {wn:.2f}x "
+                f"({wfrac:+.1%})")
     mo = num(old.get("recovery") or {}, "mttr_s")
     mn = num(new.get("recovery") or {}, "mttr_s")
     if mo and mn is not None:           # slower recovery = regression

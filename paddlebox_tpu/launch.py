@@ -515,6 +515,17 @@ def main():
                     help="pipeline the pass feed on every worker "
                          "(FLAGS_pass_prefetch): pass N+1's load/pull/"
                          "pack run in the background while pass N trains")
+    ap.add_argument("--ps_device_cache", type=int, default=None,
+                    choices=(0, 1),
+                    help="keep the hottest embedding rows resident in "
+                         "device memory across passes on every worker "
+                         "(FLAGS_ps_device_cache): build_pull fetches "
+                         "only cache misses over the wire; bit-identical "
+                         "to off")
+    ap.add_argument("--ps_device_cache_rows", type=int, default=None,
+                    help="row capacity of each worker's device-resident "
+                         "hot-row cache (FLAGS_ps_device_cache_rows; "
+                         "ps/device_cache.py)")
     ap.add_argument("--auto_resume", type=int, default=0,
                     help="crash-recovery budget (FLAGS_auto_resume): each "
                          "worker's fleet.train_passes rolls back to the "
@@ -566,6 +577,13 @@ def main():
     if args.pass_prefetch is not None:
         # pboxlint: disable-next=PB203 -- env export to spawned workers
         os.environ["FLAGS_pass_prefetch"] = str(args.pass_prefetch)
+    if args.ps_device_cache is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_ps_device_cache"] = str(args.ps_device_cache)
+    if args.ps_device_cache_rows is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_ps_device_cache_rows"] = str(
+            args.ps_device_cache_rows)
     if args.obs_flight_ring is not None:
         # pboxlint: disable-next=PB203 -- env export to spawned workers
         os.environ["FLAGS_obs_flight_ring"] = str(args.obs_flight_ring)

@@ -102,29 +102,74 @@ class PassKeyMapper:
 
 def build_working_set(host_soa: Dict[str, np.ndarray], mf_dim: int,
                       pad_to: Optional[int] = None,
-                      sharding=None) -> Dict[str, jnp.ndarray]:
+                      sharding=None,
+                      buffers: Optional[Dict[str, np.ndarray]] = None
+                      ) -> Dict[str, jnp.ndarray]:
     """Assemble the device pytree from host rows (row 0 = zeros) and place it
     with the given NamedSharding (row-sharded over the mesh).
 
     ≙ BuildGPUTask's HBM pool fill (ps_gpu_wrapper.cc:684-760) — a single
     chunked H2D per field instead of 500k-key memcpy loops.
+
+    ``buffers``, if given, is a caller-owned staging-buffer pool keyed by
+    field: when the bucketed size is unchanged from the previous pass the
+    padded host array is reused instead of reallocated (only the reserved
+    row and the stale tail are re-zeroed; metered as
+    ``ps.engine.ws_buffer_reuse``).  Reused staging is always *copied* to
+    the device (never aliased) so mutating the buffer next pass cannot
+    corrupt a live working set.
     """
+    from paddlebox_tpu.utils.monitor import stat_add
     n = len(host_soa["show"])
     total = (pad_to if pad_to is not None else size_bucket(n + 1))
     assert total >= n + 1
     ws = {}
+    reused = 0
     for f in host_soa:
         if f == "unseen_days":  # host-only lifecycle field
             continue
         src = host_soa[f]
         shape = (total,) + src.shape[1:]
-        arr = np.zeros(shape, src.dtype)
+        arr = None
+        if buffers is not None:
+            prev = buffers.get(f)
+            if prev is not None and prev.shape == shape \
+                    and prev.dtype == src.dtype:
+                arr = prev
+                arr[0] = 0          # reserved zero row
+                arr[n + 1:] = 0     # stale rows from a larger prior pass
+                reused += 1
+        if arr is None:
+            arr = np.zeros(shape, src.dtype)
+            if buffers is not None:
+                buffers[f] = arr
         arr[1:n + 1] = src
         dtype = jnp.int32 if src.dtype == np.int32 else jnp.float32
         if sharding is not None:
             ws[f] = jax.device_put(arr.astype(dtype), sharding)
+        elif buffers is not None:
+            # the staging buffer outlives this pass — force a device copy
+            ws[f] = jnp.array(arr, dtype=dtype, copy=True)
         else:
             ws[f] = jnp.asarray(arr, dtype=dtype)
+    if reused:
+        stat_add("ps.engine.ws_buffer_reuse", float(reused))
+    return ws
+
+
+def scatter_device_rows(ws: Dict[str, jnp.ndarray], rows,
+                        values: Dict[str, jnp.ndarray]
+                        ) -> Dict[str, jnp.ndarray]:
+    """Cached-plane working-set fill: scatter already-device-resident row
+    values (a DeviceRowCache gather) into the pass working set — no host
+    staging and no H2D for these rows.  Dtypes must already match the
+    working set's (the cache stores build_working_set's exact casts), so
+    ``pull_sparse``/``push_sparse_grads`` see bits identical to a wire
+    pull.  Returns the updated pytree (functional, like every ws op)."""
+    rows_d = jnp.asarray(rows)
+    for f, v in values.items():
+        if f in ws:
+            ws[f] = ws[f].at[rows_d].set(v)
     return ws
 
 

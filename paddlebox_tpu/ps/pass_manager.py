@@ -32,6 +32,7 @@ from paddlebox_tpu import flags
 from paddlebox_tpu.config import EmbeddingTableConfig
 from paddlebox_tpu.parallel.topology import HybridTopology
 from paddlebox_tpu.ps import embedding, faults
+from paddlebox_tpu.ps.device_cache import CachePlan, DeviceRowCache
 from paddlebox_tpu.ps.host_table import ShardedHostTable
 from paddlebox_tpu.utils import flight, intervals, trace
 from paddlebox_tpu.utils.monitor import stat_add, stat_set, stat_snapshot
@@ -75,8 +76,27 @@ class BoxPSEngine:
         # box_wrapper.h:1141 / ps_gpu_wrapper.cc:907-955): the next pass's
         # working set builds in the background while the current one trains
         self._build_thread: Optional[threading.Thread] = None
-        self._next: Optional[tuple] = None     # (mapper, num_keys, ws)
+        self._next: Optional[tuple] = None  # (mapper, n, host_rows, plan)
         self._last_written: Optional[np.ndarray] = None
+
+        # HBM tier: device-resident hot-row cache (ps/device_cache.py).
+        # Gated off under a sharded topology — the store would need the
+        # same row-sharding as the working set to avoid cross-device
+        # scatter traffic; single-device (the bench/test basis) first.
+        self.cache: Optional[DeviceRowCache] = None
+        if mode == "train" and topology is None \
+                and flags.get_flags("ps_device_cache"):
+            cap = int(flags.get_flags("ps_device_cache_rows"))
+            if cap > 0:
+                sgd = self.config.sgd
+                self.cache = DeviceRowCache(
+                    cap, nonclk_coeff=sgd.nonclk_coeff,
+                    clk_coeff=sgd.clk_coeff)
+        self._feed_cache_snap = None     # index snapshot for the open feed
+        self._cache_fresh_keys = None    # adoption-fresh rows (skip refresh)
+        # build_working_set staging-buffer pool (ps.engine.ws_buffer_reuse):
+        # adoption/upload is main-thread-only, so one pool per engine
+        self._ws_buffers: Dict[str, np.ndarray] = {}
 
     # -- date / phase --------------------------------------------------------
     def set_date(self, date: str) -> None:
@@ -84,6 +104,11 @@ class BoxPSEngine:
             flight.record("day_end", day=self.day_id, next_day=date)
             with self.timers("end_day"):
                 self.table.end_day()
+            # coherence point: end_day decayed show/click table-wide —
+            # every cached row is stale now (the prefetcher's day-boundary
+            # drain guarantees no feed snapshot is in flight here)
+            if self.cache is not None:
+                self.cache.invalidate("end_day")
         self.day_id = date
 
     def flip_phase(self) -> None:
@@ -110,6 +135,11 @@ class BoxPSEngine:
         }
         flight.record("pass_feed_begin", pass_id=self.pass_id + 1,
                       day=self.day_id)
+        # publish the cache index snapshot for THIS feed (prefetcher-safe:
+        # the build thread intersects against this frozen view; authoritative
+        # hit resolution re-checks the live index at adoption)
+        self._feed_cache_snap = (self.cache.snapshot()
+                                 if self.cache is not None else None)
         # the pass lifecycle is driven by one coordinator thread;
         # _agent_lock only guards the add_keys sink
         # pboxlint: disable-next=PB102 -- single-coordinator lifecycle flag
@@ -136,15 +166,41 @@ class BoxPSEngine:
         # per pass (with the end-pass delta push) — surface its wall time
         # in the monitor so the pipelined PS wire path's effect shows up
         # beside the ps.wire.* byte counters (ps/service.py)
+        snap = self._feed_cache_snap
         with self.timers("build_pull"), \
                 trace.span("ps.engine.build_pull", keys=len(uniq)):
             t0 = time.monotonic()
-            host_rows = self.table.bulk_pull(uniq)
+            plan = None
+            if snap is not None and len(snap.keys) and len(uniq):
+                # HBM tier: pull only cache MISSES over the wire; the
+                # snapshot-hit rows are filled from the device cache at
+                # adoption (begin_pass, main thread)
+                hit_mask = snap.lookup(uniq)
+                miss = uniq[~hit_mask]
+                if len(miss):
+                    pulled = self.table.bulk_pull(miss)
+                    miss_pos = np.flatnonzero(~hit_mask)
+                    host_rows = {}
+                    for f, v in pulled.items():
+                        full = np.zeros((len(uniq),) + v.shape[1:], v.dtype)
+                        full[miss_pos] = v
+                        host_rows[f] = full
+                else:
+                    host_rows = self.cache.host_templates(len(uniq))
+                plan = CachePlan(uniq[hit_mask], np.flatnonzero(hit_mask),
+                                 snap, len(miss),
+                                 miss if len(miss) else None)
+                pulled_n = len(miss)
+            else:
+                host_rows = self.table.bulk_pull(uniq)
+                pulled_n = len(uniq)
+                if self.cache is not None:
+                    stat_add("ps.cache.misses", float(len(uniq)))
             t1 = time.monotonic()
             intervals.record("pull", t0, t1)
             stat_add("ps.engine.build_pull_s", t1 - t0)
-            stat_add("ps.engine.build_pull_rows", float(len(uniq)))
-        return embedding.PassKeyMapper(uniq), len(uniq), host_rows
+            stat_add("ps.engine.build_pull_rows", float(pulled_n))
+        return embedding.PassKeyMapper(uniq), len(uniq), host_rows, plan
 
     def _upload(self, host_rows) -> Dict[str, jnp.ndarray]:
         # ctr_double accessor: the host keeps f64 show/click; the device
@@ -161,7 +217,8 @@ class BoxPSEngine:
             sharding = (self.topology.table_sharding()
                         if self.topology is not None else None)
             ws = embedding.build_working_set(
-                host_rows, self.config.embedding_dim, sharding=sharding)
+                host_rows, self.config.embedding_dim, sharding=sharding,
+                buffers=self._ws_buffers)
             intervals.record("upload", t0, time.monotonic())
             if self._pulled_stats is not None:
                 # exact per-pass counter accumulators (small magnitudes
@@ -171,9 +228,75 @@ class BoxPSEngine:
                 ws["click_acc"] = jnp.zeros_like(ws["click"])
             return ws
 
+    def _adopt(self, mapper, n: int, host_rows,
+               plan: Optional[CachePlan]) -> Dict[str, jnp.ndarray]:
+        """Main-thread working-set assembly: resolve the feed's cache plan
+        against the live index, wire-pull any hit that was evicted since
+        the snapshot, reconcile the f64 pulled-stats / delta-mode
+        write-back base, upload the miss plane and gather the hit plane
+        device-side."""
+        if plan is None or self.cache is None:
+            return self._upload(host_rows)
+        with self.timers("cache_gather"):
+            valid, slots = self.cache.resolve(plan.keys, plan.snap)
+            n_valid = int(valid.sum())
+            inv_keys = plan.keys[~valid]
+            if len(inv_keys):
+                # evicted (or invalidated) between snapshot and adoption —
+                # an ordinary wire miss, just discovered late
+                fresh = self.table.bulk_pull(inv_keys)
+                inv_pos = plan.pos[~valid]
+                for f, v in fresh.items():
+                    if f in host_rows:
+                        host_rows[f][inv_pos] = v
+                stat_add("ps.engine.build_pull_rows", float(len(inv_keys)))
+                stat_add("ps.cache.gather_fallback_rows",
+                         float(len(inv_keys)))
+            hit_pos = plan.pos[valid]
+            hit_slots = np.asarray(slots[valid], np.int32)
+            delta_seed = (getattr(self.table, "delta_mode", False)
+                          and hasattr(self.table, "seed_snapshot"))
+            if n_valid:
+                if delta_seed:
+                    # the write-back base for hit rows is the cache's host
+                    # mirror (exactly what we last wrote back for them)
+                    for f, v in self.cache.read_mirror(hit_slots).items():
+                        if f in host_rows:
+                            host_rows[f][hit_pos] = v
+                elif host_rows["show"].dtype == np.float64:
+                    # ctr_double: the f64 stats base comes from the mirror
+                    for f, v in self.cache.read_mirror(
+                            hit_slots, fields=("show", "click")).items():
+                        host_rows[f][hit_pos] = v
+            if delta_seed:
+                # delta-mode remotes snapshot what they pull — only the
+                # misses here.  Install the full assembled key set as the
+                # write-back base, dropping the partial pull snapshots.
+                consumed = [k for k in (plan.pulled_keys, inv_keys)
+                            if k is not None and len(k)]
+                self.table.seed_snapshot(mapper.sorted_keys, host_rows,
+                                         consumed=consumed)
+            ws = self._upload(host_rows)
+            if n_valid:
+                ws = self.cache.scatter_into(
+                    ws, mapper(plan.keys[valid]), hit_slots)
+            # rows assembled from post-write-back state at adoption time —
+            # the stale-row refresh must not re-pull them
+            self._cache_fresh_keys = np.union1d(
+                plan.keys[valid], inv_keys) if len(inv_keys) \
+                else plan.keys[valid]
+            n_miss = plan.n_miss + len(inv_keys)
+            stat_add("ps.cache.hits", float(n_valid))
+            stat_add("ps.cache.misses", float(n_miss))
+            stat_set("ps.cache.hit_rate",
+                     n_valid / max(n_valid + n_miss, 1))
+            stat_add("ps.cache.bytes_saved",
+                     float(n_valid * self.cache.row_bytes))
+        return ws
+
     def _build(self, uniq: np.ndarray) -> tuple:
-        mapper, n, host_rows = self._build_host(uniq)
-        return mapper, n, self._upload(host_rows)
+        mapper, n, host_rows, plan = self._build_host(uniq)
+        return mapper, n, self._adopt(mapper, n, host_rows, plan)
 
     def end_feed_pass(self, async_build: bool = False) -> None:
         """Dedup pass keys, pull host rows, build the device working set.
@@ -250,10 +373,12 @@ class BoxPSEngine:
             if self._build_thread is not None or self._next is not None:
                 self.wait_feed_pass_done()  # raises if async build failed
                 assert self._next is not None
-                self.mapper, self.num_keys, host_rows = self._next
-                self.ws = self._upload(host_rows)
+                self.mapper, self.num_keys, host_rows, plan = self._next
+                self.ws = self._adopt(self.mapper, self.num_keys,
+                                      host_rows, plan)
                 self._next = None
                 self._refresh_stale_rows()
+                self._cache_fresh_keys = None
             assert self.ws is not None, \
                 "end_feed_pass must run before begin_pass"
             # promote the pending feed-time baseline: THIS pass's report
@@ -276,6 +401,13 @@ class BoxPSEngine:
             return
         stale = np.intersect1d(self._last_written, self.mapper.sorted_keys,
                                assume_unique=True)
+        fresh_keys = self._cache_fresh_keys
+        if fresh_keys is not None and len(fresh_keys):
+            # cache hits (and adoption-time fallback pulls) were assembled
+            # AFTER the previous pass's write-back + fold-back — already
+            # fresh, and re-pulling them would hand back the wire bytes
+            # the cache just saved
+            stale = np.setdiff1d(stale, fresh_keys, assume_unique=True)
         if not len(stale):
             return
         with self.timers("refresh_stale"):
@@ -350,6 +482,32 @@ class BoxPSEngine:
                 stat_add("ps.engine.end_pass_write_failure")
                 raise
             self._pulled_stats = None
+            if self.cache is not None:
+                # fold-back: the ONLY cache row mutation (PB503) — after
+                # the table write succeeded, so a failed write-back replays
+                # end_pass with the cache untouched (exactly-once), and a
+                # checkpoint commit never sees cache-only state
+                with self.timers("cache_fold"):
+                    fold, casts = soa, None
+                    pop = getattr(self.table, "pop_write_effect", None)
+                    eff = pop() if pop is not None else None
+                    if eff is not None:
+                        # delta-mode remote: the server materialized
+                        # base+delta, which can differ from the written
+                        # soa in the last ulp — the cache must hold the
+                        # SERVER's bits or a later hit diverges from the
+                        # wire pull it replaces
+                        fold = eff
+                        casts = {f: eff[f] for f in eff
+                                 if f != "unseen_days"}
+                    elif soa["show"].dtype == np.float64:
+                        # hit rows must replay the same f64→f32 cast a
+                        # wire pull of the written row would
+                        casts = {f: soa[f].astype(np.float32)
+                                 for f in ("show", "click")}
+                    self.cache.update_after_pass(
+                        self.mapper.sorted_keys, fold, self.ws,
+                        pass_id=self.pass_id, host_casts=casts)
         self.ws = None
         self._last_written = np.asarray(self.mapper.sorted_keys)
         # feed-gap attribution over THIS pass's window (begin_feed_pass →
@@ -403,6 +561,14 @@ class BoxPSEngine:
         self.num_keys = 0
         self._pulled_stats = None
         self._last_written = None
+        self._feed_cache_snap = None
+        self._cache_fresh_keys = None
+        if self.cache is not None:
+            # coherence point: a checkpoint restore / crash teardown may
+            # roll the table back past rows the cache folded in — rebuild
+            # cold (covers io/checkpoint.resume, PassPrefetcher.abort and
+            # fleet.train_passes' auto-resume loop)
+            self.cache.invalidate("reset")
 
     def freeze_for_serving(self, scale: float = 1.0 / 32767.0) -> None:
         """Re-encode the live working set's embedx as int16 for pull-only
@@ -413,6 +579,10 @@ class BoxPSEngine:
         assert self.ws is not None, "no live working set to freeze"
         qb = self.config.quant_bits or 16
         self.ws = embedding.quantize_working_set(self.ws, qb, scale)
+        if self.cache is not None:
+            # a frozen pass never writes back — don't let its rows serve
+            # as a later pass's write base
+            self.cache.invalidate("freeze")
 
     # -- persistence ---------------------------------------------------------
     def _save(self, path: str, mode: str) -> int:
@@ -432,10 +602,17 @@ class BoxPSEngine:
     def load(self, path: str) -> int:
         rows = self.table.load(path)
         flight.record("checkpoint_load", path=path, rows=rows)
+        if self.cache is not None:
+            self.cache.invalidate("load")
         return rows
 
     def shrink(self) -> int:
-        return self.table.shrink()
+        removed = self.table.shrink()
+        if self.cache is not None:
+            # shrink evicted dead table rows — cached copies of them must
+            # not resurrect through a later fold-back's write base
+            self.cache.invalidate("shrink")
+        return removed
 
     # -- convenience ---------------------------------------------------------
     def attach_dataset(self, dataset) -> None:
@@ -482,6 +659,16 @@ class BoxPSEngine:
             f"pipeline_stall={delta('ps.client.pipeline_stall_s'):.3f}s "
             f"retries={int(delta('ps.client.retry'))} "
             f"dedup_hits={int(delta('ps.server.dedup_hit'))}")
+        ch, cm = delta("ps.cache.hits"), delta("ps.cache.misses")
+        if ch or cm:
+            # HBM-tier effectiveness for THIS pass: wire rows the device
+            # cache kept off the network, vs rows still pulled
+            lines.append(
+                f"  cache: hits={int(ch)} misses={int(cm)} "
+                f"hit_rate={ch / max(ch + cm, 1.0):.2f} "
+                f"resident={int(cur.get('ps.cache.resident_rows', 0))} "
+                f"evictions={int(delta('ps.cache.evictions'))} "
+                f"bytes_saved={int(delta('ps.cache.bytes_saved'))}")
         pool_tasks = delta("ps.pool.table.tasks")
         if pool_tasks:
             # shard-pool pressure for THIS pass: busy seconds across

@@ -1565,6 +1565,19 @@ class RemoteTableAdapter:
         self._snap_groups: Dict[bytes, str] = {}
         self._snap_cap = max(1, int(flags.get_flags("ps_snap_cap")
                                     if snap_cap is None else snap_cap))
+        # last successful delta write-back's MATERIALIZED rows (base+delta
+        # as the server computed them) — consumed by the engine's device-
+        # cache fold-back; None outside delta_mode
+        self._write_effect: Optional[Dict[str, np.ndarray]] = None
+
+    def pop_write_effect(self) -> Optional[Dict[str, np.ndarray]]:
+        """The server-side value of the rows the last ``bulk_write``
+        landed (delta mode: base + delta in the server's arithmetic, not
+        the written soa — they can differ in the last ulp).  Cleared on
+        read; the device cache folds these bits so hits replay wire pulls
+        exactly."""
+        eff, self._write_effect = self._write_effect, None
+        return eff
 
     def bulk_pull(self, keys):
         rows = self.client.pull_sparse(keys, table=self.table,
@@ -1617,6 +1630,24 @@ class RemoteTableAdapter:
             if f in snap:
                 snap[f][pos] = v
 
+    def seed_snapshot(self, full_keys, rows, consumed=()) -> None:
+        """A device-cache-assisted build pulled only cache MISSES over the
+        wire; the engine assembled the full pass rows itself (hits from
+        its host mirror — exactly the values this worker last wrote
+        back).  Install them as the write-back base for the FULL key set
+        so the later ``bulk_write(full_keys, ...)`` computes correct
+        deltas, and drop the partial pulls' own snapshots (``consumed``,
+        never written back directly) before they pressure the cap."""
+        if not self.delta_mode:
+            return
+        for sub in consumed:
+            sub_digest = np.asarray(sub, np.uint64).tobytes()
+            self._snaps.pop(sub_digest, None)
+            self._snap_groups.pop(sub_digest, None)
+        digest = np.asarray(full_keys, np.uint64).tobytes()
+        self._snaps[digest] = {f: np.array(v, copy=True)
+                               for f, v in rows.items()}
+
     def bulk_write(self, keys, soa):
         if not self.delta_mode:
             return self.client.push_sparse(keys, soa, table=self.table)
@@ -1645,6 +1676,22 @@ class RemoteTableAdapter:
             self._snap_groups[digest] = group
             stat_add("ps.adapter.writeback_retry_armed")
             raise
+        # what the SERVER now holds for these rows: base + delta in the
+        # server's own arithmetic (cur[f] + d elementwise), absolutes
+        # overwritten, unseen_days zeroed.  base+delta can differ from the
+        # written soa in the last ulp, so a device cache folding rows back
+        # (pass_manager.end_pass) must mirror THESE bits, not soa's —
+        # otherwise a later cache hit diverges from the wire pull it
+        # replaces
+        effect = {}
+        for f, v in soa.items():
+            if f in delta:
+                effect[f] = snap[f] + delta[f]
+            elif f == "unseen_days":
+                effect[f] = np.zeros_like(np.asarray(v))
+            else:
+                effect[f] = np.asarray(v)
+        self._write_effect = effect
 
     def end_day(self):
         self.client.end_day(table=self.table)

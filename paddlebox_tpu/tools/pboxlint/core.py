@@ -114,13 +114,13 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 
 def ALL_CHECKERS():
     # local import: checker modules import core for helpers
-    from paddlebox_tpu.tools.pboxlint import (atomic_io, flags_hygiene,
-                                              flight_events, lifecycle,
-                                              locks, metric_names, purity,
-                                              retries)
+    from paddlebox_tpu.tools.pboxlint import (atomic_io, device_cache,
+                                              flags_hygiene, flight_events,
+                                              lifecycle, locks, metric_names,
+                                              purity, retries)
     return (locks.check, flags_hygiene.check, metric_names.check,
             flight_events.check, purity.check, lifecycle.check,
-            retries.check, atomic_io.check)
+            retries.check, atomic_io.check, device_cache.check)
 
 
 def lint_modules(modules: Sequence[Module]) -> List[Finding]:

@@ -1,0 +1,91 @@
+"""PB5xx — device-cache coherence discipline (the fold-back rule).
+
+  PB503  a device-cache mutation outside its sanctioned call sites.  The
+         HBM row cache (ps/device_cache.py) is write-back at pass
+         granularity: the ONLY row mutation is the ``end_pass`` fold-back
+         (``cache.update_after_pass``, after the table write succeeded),
+         and the only other state change is ``cache.invalidate`` at a
+         coherence point (end_day decay, shrink, checkpoint resume /
+         rollback, feed-state reset, serving freeze, load).  A fold-back
+         from anywhere else can commit rows the table never accepted
+         (breaking exactly-once replay), and an ad-hoc invalidation —
+         or a MISSING one at a rollback — silently forks the cache from
+         the table.  Keeping both behind greppable, named lifecycle
+         functions is what makes the bit-identity argument auditable.
+
+         Scope: any call ``<something>cache<...>.update_after_pass(...)``
+         outside a function whose name mentions ``end_pass``, and any
+         ``<something>cache<...>.invalidate(...)`` outside a function
+         whose name mentions a recognized coherence point (invalidate /
+         reset / resume / rollback / restore / set_date / end_day /
+         shrink / load / close / abort / freeze / restart / teardown).
+         ``ps/device_cache.py`` itself (the implementation) and test
+         files are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
+                                               PackageContext, dotted_name)
+
+_FOLD_HINTS = ("end_pass",)
+_INVALIDATE_HINTS = ("invalidate", "reset", "resume", "rollback", "restore",
+                     "set_date", "end_day", "shrink", "load", "close",
+                     "abort", "freeze", "restart", "teardown")
+_EXEMPT_BASENAMES = ("device_cache.py",)
+
+
+def _is_cache_receiver(node: ast.Call) -> bool:
+    """The call's receiver chain names a cache (`self.cache.…`,
+    `engine.cache.…`, `row_cache.…`)."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    recv = dotted_name(func.value)
+    return "cache" in recv.lower()
+
+
+def _allowed(stack: List[str], hints) -> bool:
+    return any(any(h in fn.lower() for h in hints) for fn in stack)
+
+
+def check(mod: Module, ctx: PackageContext) -> List[Finding]:
+    path = mod.path.replace("\\", "/")
+    if mod.basename in _EXEMPT_BASENAMES or "/tests/" in path \
+            or mod.basename.startswith("test_"):
+        return []
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, stack: List[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack = stack + [node.name]
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and _is_cache_receiver(node):
+            attr = node.func.attr
+            if attr == "update_after_pass" \
+                    and not _allowed(stack, _FOLD_HINTS):
+                findings.append(Finding(
+                    mod.path, node.lineno, "PB503",
+                    "device-cache fold-back outside end_pass: "
+                    "update_after_pass may only run from the engine's "
+                    "end_pass, after the table write succeeded — a "
+                    "fold-back elsewhere can commit rows the table "
+                    "never accepted and breaks exactly-once replay"))
+            elif attr == "invalidate" \
+                    and not _allowed(stack, _INVALIDATE_HINTS):
+                findings.append(Finding(
+                    mod.path, node.lineno, "PB503",
+                    "device-cache invalidation outside a named coherence "
+                    "point (end_day/shrink/resume/rollback/reset/...): "
+                    "keep it behind a lifecycle function whose name says "
+                    "WHY the cache went cold, so the coherence audit "
+                    "stays greppable"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack)
+
+    visit(mod.tree, [])
+    return findings

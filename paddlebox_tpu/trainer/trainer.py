@@ -39,7 +39,7 @@ from paddlebox_tpu.ps import embedding, optimizer as sparse_opt
 from paddlebox_tpu.ps.pass_manager import BoxPSEngine
 from paddlebox_tpu.utils import intervals, trace
 from paddlebox_tpu.utils.channel import Channel, ChannelClosed
-from paddlebox_tpu.utils.monitor import stat_observe
+from paddlebox_tpu.utils.monitor import stat_observe, stat_snapshot
 from paddlebox_tpu.utils.timer import TimerRegistry
 from paddlebox_tpu import flags
 
@@ -997,6 +997,11 @@ class SparseTrainer:
         # PrintSyncTimer report shows pull/train/write side by side
         self.engine.timers.add("train", dt)
         stat_observe("trainer.train_pass_s", dt)
+        if getattr(self.engine, "cache", None) is not None:
+            # this pass's HBM-tier hit rate (set at adoption) rides along
+            # with the training metrics for drivers like fleet/bench
+            stats["cache_hit_rate"] = stat_snapshot("ps.cache.").get(
+                "ps.cache.hit_rate", 0.0)
         return stats
 
     def _train_stream(self, dataset: SlotDataset, prefetch: int,

@@ -1,0 +1,513 @@
+"""Device-resident hot-row embedding cache (ISSUE 10): the HBM tier.
+
+The contract under test: FLAGS_ps_device_cache changes WIRE BYTES only —
+every per-pass loss, the dense params, and the final host table are
+bit-identical to a cache-off run, serial and prefetched, under seeded PS
+connection chaos, and across a kill-at-end_pass crash/resume (the cache
+rebuilds cold and the re-driven passes still replay exactly).  Plus the
+policy units: zipf hit-rate floor, eviction under capacity pressure,
+snapshot/invalidation semantics, and the staging-buffer reuse meter.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddlebox_tpu import flags
+from paddlebox_tpu.config import (DataFeedConfig, EmbeddingTableConfig,
+                                  SlotConfig, SparseSGDConfig)
+from paddlebox_tpu.data.dataset import SlotDataset
+from paddlebox_tpu.data.prefetch import PassPrefetcher
+from paddlebox_tpu.data.slot_record import SlotRecordBlock
+from paddlebox_tpu.models.deepfm import DeepFM
+from paddlebox_tpu.ps import embedding
+from paddlebox_tpu.ps.device_cache import DeviceRowCache
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.trainer.trainer import SparseTrainer
+from paddlebox_tpu.utils import flight
+from paddlebox_tpu.utils.monitor import StatRegistry, stat_get
+
+CAP = 3
+N_DAYS, N_PASSES, B = 2, 3, 32
+
+
+@pytest.fixture(autouse=True)
+def _clean_flags():
+    prev = {k: flags.get_flags(k)
+            for k in ("ps_device_cache", "ps_device_cache_rows")}
+    StatRegistry.instance().reset()
+    yield
+    flags.set_flags(prev)
+
+
+def _cache_on(rows: int = 4096):
+    flags.set_flags({"ps_device_cache": True, "ps_device_cache_rows": rows})
+
+
+def _cache_off():
+    flags.set_flags({"ps_device_cache": False})
+
+
+# ---------------------------------------------------------------------------
+# The 2-day x 3-pass DeepFM workload (same shape as test_pass_pipeline's).
+# ---------------------------------------------------------------------------
+
+def _simple_cfg():
+    return DataFeedConfig(slots=tuple(
+        [SlotConfig("label", dtype="float", is_dense=True, dim=1),
+         SlotConfig("dense0", dtype="float", is_dense=True, dim=3)]
+        + [SlotConfig(f"s{i}", slot_id=100 + i, capacity=CAP)
+           for i in range(4)]))
+
+
+def _simple_block(rng, n, n_keys=500):
+    blk = SlotRecordBlock(n=n)
+    for i in range(4):
+        lens = rng.integers(1, CAP + 1, size=n)
+        off = np.zeros((n + 1,), np.int64)
+        np.cumsum(lens, out=off[1:])
+        blk.uint64_slots[f"s{i}"] = (
+            rng.integers(1, n_keys, size=int(off[-1])).astype(np.uint64), off)
+    blk.float_slots["label"] = (rng.integers(0, 2, n).astype(np.float32),
+                                np.arange(n + 1, dtype=np.int64))
+    blk.float_slots["dense0"] = (
+        rng.normal(0, 1, n * 3).astype(np.float32),
+        np.arange(n + 1, dtype=np.int64) * 3)
+    return blk
+
+
+def _mk_ds(cfg, day, p):
+    ds = SlotDataset(cfg)
+    ds._blocks = [_simple_block(np.random.default_rng(100 * day + 10 * p),
+                                96)]
+    return ds
+
+
+def _day_keys(cfg):
+    parts = []
+    for day in range(N_DAYS):
+        for p in range(N_PASSES):
+            for b in _mk_ds(cfg, day, p).get_blocks():
+                parts.append(b.all_keys())
+    return np.unique(np.concatenate(parts))
+
+
+def _run_days(prefetch: bool, table=None):
+    cfg = _simple_cfg()
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=4, shard_num=4,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)), seed=0)
+    if table is not None:
+        eng.table = table
+    model = DeepFM(num_slots=4, emb_width=3 + 4, dense_dim=3, hidden=(8,))
+    tr = SparseTrainer(eng, model, cfg, batch_size=B, seed=0,
+                       sparse_path="fast")
+    losses = []
+    if not prefetch:
+        for day in range(N_DAYS):
+            eng.set_date(f"2026080{day + 1}")
+            for p in range(N_PASSES):
+                ds = _mk_ds(cfg, day, p)
+                eng.begin_feed_pass()
+                for b in ds.get_blocks():
+                    eng.add_keys(b.all_keys())
+                eng.end_feed_pass()
+                eng.begin_pass()
+                feed = tr.build_pass_feed(ds)
+                losses.append(tr.train_pass(feed)["loss"])
+                eng.end_pass()
+        return losses, eng, tr
+
+    pre = PassPrefetcher(eng, tr)
+    try:
+        for day in range(N_DAYS):
+            for p in range(N_PASSES):
+                def load(day=day, p=p):
+                    ds = _mk_ds(cfg, day, p)
+                    for b in ds.get_blocks():
+                        eng.add_keys(b.all_keys())
+                    return ds
+                pre.submit(load, tag=f"d{day}p{p}",
+                           date=f"2026080{day + 1}")
+        for _ in range(N_DAYS * N_PASSES):
+            feed = pre.next_pass()
+            losses.append(tr.train_pass(feed)["loss"])
+            pre.end_pass()
+    finally:
+        pre.close()
+    return losses, eng, tr
+
+
+def _assert_runs_identical(a, b, keys):
+    losses1, eng1, tr1 = a
+    losses2, eng2, tr2 = b
+    np.testing.assert_array_equal(np.asarray(losses1), np.asarray(losses2))
+    s1, s2 = eng1.table.bulk_pull(keys), eng2.table.bulk_pull(keys)
+    assert set(s1) == set(s2)
+    for f in s1:
+        np.testing.assert_array_equal(np.asarray(s1[f]), np.asarray(s2[f]),
+                                      err_msg=f"table field {f!r}")
+    import jax
+    for p1, p2 in zip(jax.tree_util.tree_leaves(tr1.params),
+                      jax.tree_util.tree_leaves(tr2.params)):
+        np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity: cache on == cache off, over the full 2-day workload.
+# ---------------------------------------------------------------------------
+
+def test_cache_on_serial_bit_identical():
+    """Cache-on serial run == cache-off serial run — losses, final table,
+    dense params — while actually serving hits (not a vacuous pass)."""
+    keys = _day_keys(_simple_cfg())
+    _cache_off()
+    want = _run_days(prefetch=False)
+    _cache_on()
+    pulled0 = stat_get("ps.engine.build_pull_rows")
+    got = _run_days(prefetch=False)
+    _assert_runs_identical(want, got, keys)
+    assert stat_get("ps.cache.hits") > 0
+    # the miss-only pull means the cache-on run pulled strictly fewer
+    # rows over the wire than the keys its passes trained
+    assert stat_get("ps.engine.build_pull_rows") - pulled0 \
+        < stat_get("ps.cache.hits") + stat_get("ps.cache.misses")
+    assert got[1].cache is not None and got[1].cache.resident_rows > 0
+
+
+def test_cache_on_prefetched_bit_identical():
+    """The overlap case: snapshot published on the worker thread, misses
+    pulled on the build thread, hits resolved + gathered at adoption —
+    still bit-identical to the serial cache-off loop, both days (the
+    day-boundary drain orders end_day's invalidation after the last
+    fold-back)."""
+    keys = _day_keys(_simple_cfg())
+    _cache_off()
+    want = _run_days(prefetch=False)
+    _cache_on()
+    got = _run_days(prefetch=True)
+    _assert_runs_identical(want, got, keys)
+    assert stat_get("ps.cache.hits") > 0
+
+
+def test_cache_chaos_delta_mode_bit_identical():
+    """Cache + prefetch + delta-mode remote PS under seeded connection
+    chaos: the miss-only pull snapshots only misses, so the engine seeds
+    the full-key write-back base itself — deltas must still converge to
+    the fault-free cache-off serial state bit for bit."""
+    from paddlebox_tpu.ps import faults
+    from paddlebox_tpu.ps.host_table import ShardedHostTable
+    from paddlebox_tpu.ps.service import PSClient, PSServer, \
+        RemoteTableAdapter
+
+    tcfg = EmbeddingTableConfig(embedding_dim=4, shard_num=4,
+                                sgd=SparseSGDConfig(mf_create_thresholds=0.0))
+    keys = _day_keys(_simple_cfg())
+    flags.set_flags({"ps_fault_injection": True})
+    srv1 = srv2 = None
+    try:
+        table1 = ShardedHostTable(tcfg, seed=0)
+        srv1 = PSServer(table1)
+        client1 = PSClient(srv1.addr, retries=None, retry_sleep=0.01,
+                           backoff_cap=0.1, deadline=60)
+        _cache_off()
+        want = _run_days(prefetch=False,
+                         table=RemoteTableAdapter(client1, delta_mode=True))
+
+        table2 = ShardedHostTable(tcfg, seed=0)
+        srv2 = PSServer(table2)
+        client2 = PSClient(srv2.addr, retries=None, retry_sleep=0.01,
+                           backoff_cap=0.1, deadline=60)
+        _cache_on()
+        faults.install(
+            faults.FaultPlan(seed=17)
+            .drop("send", role="client", prob=0.04)
+            .drop("recv", role="client", prob=0.03)
+            .delay("send", 0.002, role="client", prob=0.1))
+        got = _run_days(prefetch=True,
+                        table=RemoteTableAdapter(client2, delta_mode=True))
+        faults.uninstall()
+
+        np.testing.assert_array_equal(np.asarray(want[0]),
+                                      np.asarray(got[0]))
+        s1, s2 = table1.bulk_pull(keys), table2.bulk_pull(keys)
+        for f in s1:
+            np.testing.assert_array_equal(s1[f], s2[f],
+                                          err_msg=f"table field {f!r}")
+        assert stat_get("ps.cache.hits") > 0
+    finally:
+        faults.uninstall()
+        flags.set_flags({"ps_fault_injection": False})
+        for srv in (srv1, srv2):
+            if srv is not None:
+                srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Crash/resume: kill at end_pass, cache rebuilds cold, still identical.
+# ---------------------------------------------------------------------------
+
+def _write_slot_file(path, rng, n):
+    with open(path, "w") as f:
+        for _ in range(n):
+            parts = [f"1 {rng.integers(0, 2)}",
+                     "3 " + " ".join(f"{rng.normal():.4f}"
+                                     for _ in range(3))]
+            for _s in range(4):
+                k = rng.integers(1, CAP + 1)
+                parts.append(f"{k} " + " ".join(
+                    str(rng.integers(1, 500)) for _ in range(k)))
+            f.write(" ".join(parts) + "\n")
+
+
+@pytest.mark.parametrize("prefetch", [False, True])
+def test_cache_crash_resume_bit_identical(tmp_path, prefetch):
+    """A seeded kill at pass-1's write-back with the cache ON: auto-resume
+    rolls the table back and the cache is invalidated at BOTH teardown
+    points (reset_feed_state + checkpoint resume) — the re-driven passes
+    rebuild it cold and the run still lands on the cache-off state."""
+    from paddlebox_tpu import fleet
+    from paddlebox_tpu.io.checkpoint import TrainCheckpoint
+    from paddlebox_tpu.ps import faults
+
+    cfg = _simple_cfg()
+    files = []
+    for p in range(3):
+        path = str(tmp_path / f"p{p}.txt")
+        _write_slot_file(path, np.random.default_rng(p), 48)
+        files.append([path])
+
+    def fresh():
+        eng = BoxPSEngine(EmbeddingTableConfig(
+            embedding_dim=4, shard_num=4,
+            sgd=SparseSGDConfig(mf_create_thresholds=0.0)), seed=0)
+        ds = fleet.BoxPSDataset(cfg, engine=eng, read_threads=1)
+        model = DeepFM(num_slots=4, emb_width=3 + 4, dense_dim=3,
+                       hidden=(8,))
+        tr = SparseTrainer(eng, model, cfg, batch_size=32, seed=0,
+                           sparse_path="fast")
+        return eng, ds, tr
+
+    _cache_off()
+    eng1, ds1, tr1 = fresh()
+    base = fleet.train_passes(tr1, ds1, files, date="20260801",
+                              prefetch=False)
+
+    _cache_on()
+    flags.set_flags({"ps_fault_injection": True})
+    eng2, ds2, tr2 = fresh()
+    ck = TrainCheckpoint(str(tmp_path / "ckpt"))
+    try:
+        faults.install(faults.FaultPlan(seed=13).kill_at("end_pass",
+                                                         at=(1,)))
+        metrics = fleet.train_passes(tr2, ds2, files, date="20260801",
+                                     prefetch=prefetch, checkpoint=ck,
+                                     resume=4)
+    finally:
+        faults.uninstall()
+        flags.set_flags({"ps_fault_injection": False})
+
+    np.testing.assert_array_equal([m["loss"] for m in base],
+                                  [m["loss"] for m in metrics])
+    keys = np.sort(np.concatenate([s.keys for s in eng1.table._shards]))
+    s1, s2 = eng1.table.bulk_pull(keys), eng2.table.bulk_pull(keys)
+    for f in s1:
+        np.testing.assert_array_equal(np.asarray(s1[f]), np.asarray(s2[f]),
+                                      err_msg=f"table field {f!r}")
+    import jax
+    for p1, p2 in zip(jax.tree_util.tree_leaves(tr1.params),
+                      jax.tree_util.tree_leaves(tr2.params)):
+        np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+    # the cold rebuild actually happened (resume-path invalidation fired)
+    assert flight.events(kind="cache_invalidate")
+    assert stat_get("ps.fault.lifecycle.kill") >= 1
+
+
+# ---------------------------------------------------------------------------
+# Hit-rate floor on a synthetic zipf day.
+# ---------------------------------------------------------------------------
+
+def _zipf_block(rng, n, n_keys=2000, a=1.3):
+    """Heavy-head key draw: the day's hot rows repeat across passes."""
+    blk = SlotRecordBlock(n=n)
+    for i in range(4):
+        lens = rng.integers(1, CAP + 1, size=n)
+        off = np.zeros((n + 1,), np.int64)
+        np.cumsum(lens, out=off[1:])
+        draws = np.minimum(rng.zipf(a, size=int(off[-1])), n_keys)
+        blk.uint64_slots[f"s{i}"] = (draws.astype(np.uint64), off)
+    blk.float_slots["label"] = (rng.integers(0, 2, n).astype(np.float32),
+                                np.arange(n + 1, dtype=np.int64))
+    blk.float_slots["dense0"] = (
+        rng.normal(0, 1, n * 3).astype(np.float32),
+        np.arange(n + 1, dtype=np.int64) * 3)
+    return blk
+
+
+def test_cache_zipf_hit_rate_floor():
+    """On a zipf-skewed day the steady-state pass hit rate must clear
+    0.5 and the miss-only pull must cut total wire rows by >= 2x vs the
+    every-key pulls a cache-off run would issue."""
+    cfg = _simple_cfg()
+    _cache_on(rows=8192)
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=4, shard_num=4,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)), seed=0)
+    model = DeepFM(num_slots=4, emb_width=3 + 4, dense_dim=3, hidden=(8,))
+    tr = SparseTrainer(eng, model, cfg, batch_size=B, seed=0,
+                       sparse_path="fast")
+    eng.set_date("20260801")
+    warm = {}
+    for p in range(6):
+        if p == 1:
+            # steady state starts once the cold first pass has folded its
+            # rows in — measure from here
+            warm = {k: stat_get(k) for k in
+                    ("ps.cache.hits", "ps.cache.misses",
+                     "ps.engine.build_pull_rows")}
+        ds = SlotDataset(cfg)
+        ds._blocks = [_zipf_block(np.random.default_rng(p), 96)]
+        eng.begin_feed_pass()
+        for b in ds.get_blocks():
+            eng.add_keys(b.all_keys())
+        eng.end_feed_pass()
+        eng.begin_pass()
+        feed = tr.build_pass_feed(ds)
+        tr.train_pass(feed)
+        eng.end_pass()
+    hits = stat_get("ps.cache.hits") - warm["ps.cache.hits"]
+    misses = stat_get("ps.cache.misses") - warm["ps.cache.misses"]
+    assert hits + misses > 0
+    rate = hits / (hits + misses)
+    assert rate >= 0.5, f"zipf hit rate {rate:.2f} below floor"
+    # wire reduction: rows actually pulled vs rows a cache-off run pulls
+    pulled = stat_get("ps.engine.build_pull_rows") \
+        - warm["ps.engine.build_pull_rows"]
+    assert (hits + misses) / max(pulled, 1.0) >= 2.0
+    assert stat_get("ps.cache.bytes_saved") > 0
+
+
+# ---------------------------------------------------------------------------
+# Policy units: eviction under capacity pressure, snapshot semantics.
+# ---------------------------------------------------------------------------
+
+def _mk_pass(keys, shows, clicks):
+    """Minimal (keys, soa, ws) trio shaped like a real pass: ws rows 1..n
+    carry build_working_set's casts of the host rows."""
+    keys = np.asarray(keys, np.uint64)
+    order = np.argsort(keys)
+    keys = keys[order]
+    n = len(keys)
+    soa = {
+        "show": np.asarray(shows, np.float32)[order],
+        "click": np.asarray(clicks, np.float32)[order],
+        "embed_w": np.linspace(0, 1, n, dtype=np.float32),
+        "unseen_days": np.zeros((n,), np.float32),
+    }
+    ws = {}
+    for f in ("show", "click", "embed_w"):
+        ws[f] = jnp.asarray(
+            np.concatenate([[0], soa[f], [0]]).astype(np.float32))
+    return keys, soa, ws
+
+
+def test_eviction_under_capacity_pressure():
+    cache = DeviceRowCache(capacity=4)
+    keys, soa, ws = _mk_pass([10, 11, 12, 13],
+                             shows=[50, 40, 30, 20], clicks=[0, 0, 0, 0])
+    cache.update_after_pass(keys, soa, ws, pass_id=0)
+    assert cache.resident_rows == 4
+
+    # a hotter newcomer evicts exactly the coldest incumbent; a colder
+    # one is refused — capacity never overshoots
+    keys2, soa2, ws2 = _mk_pass([20, 21], shows=[100, 1], clicks=[0, 0])
+    cache.update_after_pass(keys2, soa2, ws2, pass_id=1)
+    assert cache.resident_rows == 4
+    snap = cache.snapshot()
+    resident = set(snap.keys.tolist())
+    assert 20 in resident          # score 10 beat the coldest (13, score 2)
+    assert 13 not in resident
+    assert 21 not in resident      # score 0.1 lost to every incumbent
+    assert {10, 11, 12} <= resident
+
+    # rows touched by the CURRENT pass are never its eviction victims
+    cache2 = DeviceRowCache(capacity=2)
+    k, s, w = _mk_pass([1, 2], shows=[5, 3], clicks=[0, 0])
+    cache2.update_after_pass(k, s, w, pass_id=0)
+    k, s, w = _mk_pass([2, 3], shows=[3, 1000], clicks=[0, 0])
+    cache2.update_after_pass(k, s, w, pass_id=1)
+    resident2 = set(cache2.snapshot().keys.tolist())
+    assert resident2 == {2, 3}     # evicted the untouched 1, kept 2
+    assert stat_get("ps.cache.evictions") >= 2
+    assert flight.events(kind="cache_evict")
+
+
+def test_eviction_is_deterministic():
+    """Same passes, same order -> byte-identical index (lexsort ties on
+    key, never dict order)."""
+    def run():
+        c = DeviceRowCache(capacity=3)
+        k, s, w = _mk_pass([5, 6, 7, 8], shows=[2, 2, 2, 2],
+                           clicks=[0, 0, 0, 0])
+        c.update_after_pass(k, s, w, pass_id=0)
+        k, s, w = _mk_pass([9, 10], shows=[3, 3], clicks=[1, 1])
+        c.update_after_pass(k, s, w, pass_id=1)
+        return c.snapshot().keys
+    np.testing.assert_array_equal(run(), run())
+
+
+def test_snapshot_and_invalidation_semantics():
+    cache = DeviceRowCache(capacity=8)
+    keys, soa, ws = _mk_pass([3, 1, 2], shows=[1, 1, 1], clicks=[0, 0, 0])
+    cache.update_after_pass(keys, soa, ws, pass_id=0)
+
+    snap = cache.snapshot()
+    probe = np.asarray([1, 2, 4], np.uint64)
+    np.testing.assert_array_equal(snap.lookup(probe), [True, True, False])
+    valid, slots = cache.resolve(probe[:2], snap)
+    assert valid.all()
+    # the mirror rows behind those slots are the exact written soa bits
+    mirror = cache.read_mirror(slots, fields=("show",))
+    np.testing.assert_array_equal(mirror["show"], [1.0, 1.0])
+
+    v0 = cache.version
+    cache.invalidate("test")
+    assert cache.version == v0 + 1 and cache.resident_rows == 0
+    # a stale snapshot resolves as all-miss, never a wrong slot
+    valid, _ = cache.resolve(probe[:2], snap)
+    assert not valid.any()
+    assert len(cache.snapshot().keys) == 0
+    assert flight.events(kind="cache_invalidate")
+
+    # planes survive the invalidation and the next fold-back repopulates
+    cache.update_after_pass(keys, soa, ws, pass_id=1)
+    assert cache.resident_rows == 3
+
+
+# ---------------------------------------------------------------------------
+# Satellite: build_working_set staging-buffer reuse.
+# ---------------------------------------------------------------------------
+
+def test_ws_buffer_reuse_no_aliasing():
+    """Same bucket -> the padded staging arrays are reused (metered), and
+    the device copy is real: mutating the buffer afterwards must not
+    change a live working set's bits."""
+    n = 10
+    soa = {"show": np.arange(n, dtype=np.float32),
+           "click": np.zeros(n, np.float32),
+           "slot": np.arange(n, dtype=np.int32)}
+    bufs = {}
+    before = stat_get("ps.engine.ws_buffer_reuse")
+    ws1 = embedding.build_working_set(soa, 4, buffers=bufs)
+    soa2 = {f: v + 1 for f, v in soa.items()}
+    ws2 = embedding.build_working_set(soa2, 4, buffers=bufs)
+    assert stat_get("ps.engine.ws_buffer_reuse") - before >= 3
+    plain = embedding.build_working_set(soa2, 4)
+    for f in ws2:
+        np.testing.assert_array_equal(np.asarray(ws2[f]),
+                                      np.asarray(plain[f]))
+    # ws1 was built from the SAME staging arrays ws2 overwrote — its
+    # device copy must still hold the original values
+    np.testing.assert_array_equal(np.asarray(ws1["show"])[1:n + 1],
+                                  soa["show"])

@@ -758,6 +758,78 @@ def test_pb502_suppression_escape():
     assert codes(src) == []
 
 
+# -- PB503 device-cache coherence discipline ---------------------------------
+
+def test_pb503_foldback_outside_end_pass():
+    src = """
+    def train_step(self, feed):
+        self.cache.update_after_pass(keys, soa, ws, pass_id=0)
+    """
+    assert codes(src) == ["PB503"]
+
+
+def test_pb503_foldback_inside_end_pass_ok():
+    src = """
+    def end_pass(self):
+        self.table.bulk_write(keys, soa)
+        self.cache.update_after_pass(keys, soa, ws, pass_id=self.pass_id)
+    """
+    assert codes(src) == []
+
+
+def test_pb503_invalidate_outside_coherence_point():
+    src = """
+    def train_pass(self, feed):
+        engine.cache.invalidate("just in case")
+    """
+    assert codes(src) == ["PB503"]
+
+
+def test_pb503_invalidate_at_named_coherence_points_ok():
+    src = """
+    def set_date(self, date):
+        self.cache.invalidate("end_day")
+
+    def reset_feed_state(self):
+        self.cache.invalidate("reset")
+
+    def resume(self, engine, trainer):
+        engine.cache.invalidate("resume")
+
+    def shrink(self):
+        self.cache.invalidate("shrink")
+    """
+    assert codes(src) == []
+
+
+def test_pb503_non_cache_receiver_out_of_scope():
+    # same attr names on a non-cache receiver are someone else's protocol
+    src = """
+    def train_step(self):
+        self.stats.invalidate("x")
+        self.pool.update_after_pass(1)
+    """
+    assert codes(src) == []
+
+
+def test_pb503_implementation_and_tests_exempt():
+    src = """
+    def helper(self):
+        self.cache.invalidate("mid-flight")
+    """
+    assert codes(src, path="paddlebox_tpu/ps/device_cache.py") == []
+    assert codes(src, path="tests/test_device_cache.py") == []
+
+
+def test_pb503_suppression_escape():
+    src = """
+    def drain(self):
+        # pboxlint: disable-next=PB503 -- elastic relaunch teardown
+        self.cache.invalidate("relaunch")
+    """
+    assert codes(src) == []
+
+
 def test_suppression_same_line_and_next_line():
     base = """
     import threading
