@@ -23,6 +23,7 @@ import numpy as np
 from paddlebox_tpu.config import DataFeedConfig
 from paddlebox_tpu.data.data_feed import DataFeed
 from paddlebox_tpu.data.slot_record import SlotRecordBlock
+from paddlebox_tpu.utils import lockdep
 from paddlebox_tpu.utils.channel import Channel
 from paddlebox_tpu.utils.monitor import stat_add
 from paddlebox_tpu import flags
@@ -112,7 +113,7 @@ class SlotDataset:
         self.filelist: List[str] = []
         self._blocks: List[SlotRecordBlock] = []
         self._preload_future = None
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("data.dataset.SlotDataset._lock")
         self._rng = np.random.default_rng(feed_config.rand_seed or None)
         self._key_consumers: List[Callable[[np.ndarray], None]] = []
 
@@ -128,7 +129,7 @@ class SlotDataset:
     def _read_all(self) -> List[SlotRecordBlock]:
         files = list(self.filelist)
         blocks: List[SlotRecordBlock] = []
-        lock = threading.Lock()
+        lock = lockdep.lock("data.dataset.SlotDataset._read_all.lock")
 
         rate = self.feed_config.sample_rate
 

@@ -53,7 +53,7 @@ import time
 from typing import Callable, Optional
 
 from paddlebox_tpu import flags
-from paddlebox_tpu.utils import flight, trace
+from paddlebox_tpu.utils import flight, lockdep, trace
 from paddlebox_tpu.utils.channel import Channel, ChannelClosed
 from paddlebox_tpu.utils.monitor import stat_add, stat_observe
 
@@ -117,7 +117,7 @@ class PassPrefetcher:
         # pipeline position counters (one condition guards all three):
         # worker spec index vs how many passes the consumer has adopted
         # (begin_pass done) and ended (write-back done)
-        self._cond = threading.Condition()
+        self._cond = lockdep.condition("data.prefetch.PassPrefetcher._cond")
         self._adopted_n = 0
         self._ended_n = 0
         self._closing = False

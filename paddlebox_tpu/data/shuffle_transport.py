@@ -20,6 +20,7 @@ import numpy as np
 from paddlebox_tpu.data.dataset import ShuffleTransport
 from paddlebox_tpu.data.slot_record import SlotRecordBlock
 from paddlebox_tpu.ps import wire
+from paddlebox_tpu.utils import lockdep
 from paddlebox_tpu.utils.channel import Channel
 
 _MSG_BLOCK = 0
@@ -108,13 +109,13 @@ class TcpShuffleTransport(ShuffleTransport):
         self._mail = Channel()
         self._rx_error = None
         self._done_from = set()
-        self._done_lock = threading.Lock()
+        self._done_lock = lockdep.lock("data.shuffle_transport.TcpShuffleTransport._done_lock")
         self._done_cv = threading.Condition(self._done_lock)
         # _conn_lock guards the registries only (PB104: never frame I/O);
         # per-destination send locks serialize frames on ONE peer's socket
         # without stalling senders to OTHER peers behind a global lock
         self._conns: Dict[int, socket.socket] = {}
-        self._conn_lock = threading.Lock()
+        self._conn_lock = lockdep.lock("data.shuffle_transport.TcpShuffleTransport._conn_lock")
         self._send_locks: Dict[int, threading.Lock] = {}
 
         host, port = self._addrs[rank]
@@ -193,7 +194,8 @@ class TcpShuffleTransport(ShuffleTransport):
         with self._conn_lock:
             lk = self._send_locks.get(dst)
             if lk is None:
-                lk = self._send_locks[dst] = threading.Lock()
+                lk = self._send_locks[dst] = lockdep.lock(
+                    "data.shuffle_transport.TcpShuffleTransport._send_locks")
             return lk
 
     # ------------------------------------------------------------------

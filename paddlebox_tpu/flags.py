@@ -65,24 +65,33 @@ def all_flags() -> Dict[str, Any]:
 # Core flag set (parity with the PaddleBox block, flags.cc:946-975, plus
 # TPU-specific knobs).
 # ---------------------------------------------------------------------------
+# pboxlint: disable-next=PB205 -- paper-fidelity registry entry (PaddleBox parity), not yet wired
 define_flag("enable_pullpush_dedup_keys", True,
             "dedup minibatch keys before pull/push (flags.cc:946)")
+# pboxlint: disable-next=PB205 -- paper-fidelity registry entry (PaddleBox parity), not yet wired
 define_flag("enable_pull_box_padding_zero", True,
             "key 0 pulls a zero embedding (flags.cc:950)")
+# pboxlint: disable-next=PB205 -- paper-fidelity registry entry (PaddleBox parity), not yet wired
 define_flag("record_pool_max_size", 2_000_000,
             "SlotRecord arena cap (flags.cc:956 padbox_record_pool_max_size)")
+# pboxlint: disable-next=PB205 -- paper-fidelity registry entry (PaddleBox parity), not yet wired
 define_flag("dataset_shuffle_thread_num", 20,
             "global-shuffle sender threads (flags.cc:966)")
+# pboxlint: disable-next=PB205 -- paper-fidelity registry entry (PaddleBox parity), not yet wired
 define_flag("dataset_merge_thread_num", 20,
             "shuffle-receiver merge threads (flags.cc:968)")
+# pboxlint: disable-next=PB205 -- paper-fidelity registry entry (PaddleBox parity), not yet wired
 define_flag("auc_runner_mode", False,
             "enable AucRunner slot-replacement eval (flags.cc:972)")
 define_flag("check_nan_inf", False,
             "per-batch NaN/Inf scan of model outputs (boxps_worker.cc:1326)")
+# pboxlint: disable-next=PB205 -- paper-fidelity registry entry (PaddleBox parity), not yet wired
 define_flag("feed_pass_thread_num", 8,
             "threads used to extract pass feasigns (box_wrapper.h:873 uses 30)")
+# pboxlint: disable-next=PB205 -- paper-fidelity registry entry (PaddleBox parity), not yet wired
 define_flag("pass_build_chunk", 500_000,
             "host->device pass-build chunk size (ps_gpu_wrapper.cc:757)")
+# pboxlint: disable-next=PB205 -- paper-fidelity registry entry (PaddleBox parity), not yet wired
 define_flag("tpu_batch_key_capacity", 0,
             "static per-batch key capacity; 0 = derive from data feed config")
 define_flag("sharded_exchange_bf16", False,

@@ -15,6 +15,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 import jax
+
+from paddlebox_tpu.utils import lockdep
 import jax.numpy as jnp
 
 
@@ -28,7 +30,7 @@ class ReplicaCache:
         self.dim = dim
         self._rows: List[np.ndarray] = [np.zeros((dim,), np.float32)]
         self._device: Optional[jnp.ndarray] = None
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ps.aux_tables.ReplicaCache._lock")
 
     def add_item(self, vec: np.ndarray) -> int:
         with self._lock:
@@ -69,7 +71,7 @@ class InputTable:
 
     def __init__(self):
         self._map: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ps.aux_tables.InputTable._lock")
 
     def get_or_insert(self, key: str) -> int:
         with self._lock:

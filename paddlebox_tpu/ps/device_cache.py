@@ -52,7 +52,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from paddlebox_tpu.ps import embedding
-from paddlebox_tpu.utils import flight
+from paddlebox_tpu.utils import flight, lockdep
 from paddlebox_tpu.utils.monitor import stat_add, stat_set
 
 
@@ -118,7 +118,7 @@ class DeviceRowCache:
         self.capacity = int(capacity)
         self.nonclk_coeff = float(nonclk_coeff)
         self.clk_coeff = float(clk_coeff)
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ps.device_cache.DeviceRowCache._lock")
         self.version = 0
         # copy-on-write index: sorted resident keys + their slots
         self._keys = np.empty((0,), np.uint64)

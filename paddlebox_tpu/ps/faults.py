@@ -50,7 +50,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from paddlebox_tpu import flags
-from paddlebox_tpu.utils import flight
+from paddlebox_tpu.utils import flight, lockdep
 from paddlebox_tpu.utils.monitor import stat_add
 
 flags.define_flag(
@@ -111,7 +111,7 @@ class FaultPlan:
         self._rng = random.Random(seed)
         self._rules: List[_Rule] = []
         self._hits: Dict[Tuple[str, Optional[str]], int] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ps.faults.FaultPlan._lock")
         self.killed = threading.Event()   # set when a kill_server fires
 
     # -- builders ------------------------------------------------------------
@@ -375,7 +375,7 @@ class ChaosProxy:
                  host: str = "127.0.0.1", port: int = 0):
         self._plan = plan
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ps.faults.ChaosProxy._lock")
         self._backend: Tuple[str, int] = tuple(backend)
         self._conns: set = set()
         self._listener = socket.create_server((host, port))

@@ -13,6 +13,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, Tuple
 
+from paddlebox_tpu.utils import lockdep
+
 import numpy as np
 
 
@@ -23,7 +25,7 @@ class GeoSparseTable:
         self.lr = learning_rate
         self._values: Dict[int, np.ndarray] = {}
         self._pending = [set() for _ in range(num_trainers)]
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ps.geo_table.GeoSparseTable._lock")
 
     # -- init / direct access ----------------------------------------------
     def push_sparse_param(self, keys: np.ndarray,

@@ -36,7 +36,7 @@ import numpy as np
 
 from paddlebox_tpu.config import EmbeddingTableConfig
 from paddlebox_tpu.ps import feature_value as fv
-from paddlebox_tpu.utils import workpool
+from paddlebox_tpu.utils import lockdep, workpool
 from paddlebox_tpu.utils.monitor import stat_observe
 
 _GROW_MIN = 64      # first allocation floor (rows)
@@ -59,7 +59,7 @@ class _Shard:
         self.mf_dim = mf_dim
         # RLock: lookup lazily builds index state (native hash / sorted
         # view) and is called both bare (readers) and from under upsert
-        self.lock = threading.RLock()
+        self.lock = lockdep.rlock("ps.host_table._Shard.lock")
         self._hash = None           # native index (row = insertion order)
         self._hash_tried = False
         self._sorted_view = None    # fallback: (sorted_keys, order)

@@ -34,7 +34,7 @@ from paddlebox_tpu.parallel.topology import HybridTopology
 from paddlebox_tpu.ps import embedding, faults
 from paddlebox_tpu.ps.device_cache import CachePlan, DeviceRowCache
 from paddlebox_tpu.ps.host_table import ShardedHostTable
-from paddlebox_tpu.utils import flight, intervals, trace
+from paddlebox_tpu.utils import flight, intervals, lockdep, trace
 from paddlebox_tpu.utils.monitor import stat_add, stat_set, stat_snapshot
 from paddlebox_tpu.utils.timer import TimerRegistry
 
@@ -64,7 +64,7 @@ class BoxPSEngine:
         self.pass_id = 0
         self.phase = 1  # join/update flip (≙ FlipPhase box_wrapper.h:805)
 
-        self._agent_lock = threading.Lock()
+        self._agent_lock = lockdep.lock("ps.pass_manager.BoxPSEngine._agent_lock")
         self._agent_keys: List[np.ndarray] = []
         self._feeding = False
 

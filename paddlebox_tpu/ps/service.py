@@ -70,7 +70,7 @@ import numpy as np
 from paddlebox_tpu import flags
 from paddlebox_tpu.ps import faults, wire
 from paddlebox_tpu.ps.host_table import ShardedHostTable
-from paddlebox_tpu.utils import flight, trace
+from paddlebox_tpu.utils import flight, lockdep, trace
 from paddlebox_tpu.utils.backoff import Backoff
 from paddlebox_tpu.utils.monitor import (stat_add, stat_max, stat_observe,
                                          stat_snapshot)
@@ -170,7 +170,7 @@ class _DedupWindow:
         self.cap = cap
         self.token_cap = token_cap
         self.wait_timeout = wait_timeout
-        self._cv = threading.Condition()
+        self._cv = lockdep.condition("ps.service._DedupWindow._cv")
         # token -> OrderedDict[rid -> [done, resp]]
         self._by_token: "OrderedDict[str, OrderedDict]" = OrderedDict()
 
@@ -345,17 +345,19 @@ class PSServer:
         else:
             self.tables = {DEFAULT_TABLE: table}
         self.dense: Dict[str, np.ndarray] = {}
-        self._dense_lock = threading.Lock()
+        self._dense_lock = lockdep.lock("ps.service.PSServer._dense_lock")
         # per-table: delta merges need read-modify-write atomicity only
         # against the SAME table; unrelated tables stay concurrent
-        self._delta_locks = {name: threading.Lock() for name in self.tables}
+        self._delta_locks = {
+            name: lockdep.lock("ps.service.PSServer._delta_locks")
+            for name in self.tables}
         self._barrier_count = 0
         self._barrier_gen = 0
-        self._barrier_cv = threading.Condition()
+        self._barrier_cv = lockdep.condition("ps.service.PSServer._barrier_cv")
         # keyed cross-worker array allreduce (metric aggregation —
         # ≙ fleet.metrics gloo all_reduce of stat_pos/stat_neg,
         # fleet/metrics/metric.py:144)
-        self._reduce_cv = threading.Condition()
+        self._reduce_cv = lockdep.condition("ps.service.PSServer._reduce_cv")
         self._reduces: Dict[str, Dict] = {}
         self._dedup = _DedupWindow(cap=flags.get_flags("ps_dedup_window"))
         if dedup_state:
@@ -368,12 +370,12 @@ class PSServer:
         # lifecycle: _life_lock guards the dead flag (shutdown/kill may
         # race from a fault hook thread); _inflight_cv counts verbs being
         # executed so a graceful drain can wait them out
-        self._life_lock = threading.Lock()
+        self._life_lock = lockdep.lock("ps.service.PSServer._life_lock")
         self._dead = False
         self._draining = False
         self._inflight = 0
-        self._inflight_cv = threading.Condition()
-        self._conns_lock = threading.Lock()
+        self._inflight_cv = lockdep.condition("ps.service.PSServer._inflight_cv")
+        self._conns_lock = lockdep.lock("ps.service.PSServer._conns_lock")
         self._conns: set = set()
         outer = self
 
@@ -571,9 +573,13 @@ class PSServer:
                 # persist fresh-row defaults on first pull so every worker
                 # of a multi-trainer job sees identical base values
                 # (delta write-back sums against a common base)
+                # The per-table delta lock exists to serialize whole verbs
+                # (read-modify-write atomicity for concurrent trainers), so
+                # the pool fan-out inside bulk ops is intentionally part of
+                # the guarded region — the "blocking" is the work itself.
                 with self._delta_locks[req.get("table") or DEFAULT_TABLE]:
-                    rows = t.bulk_pull(req["keys"])
-                    t.bulk_write(req["keys"], rows)
+                    rows = t.bulk_pull(req["keys"])   # pboxlint: disable=PB602 -- verb-serialization by design
+                    t.bulk_write(req["keys"], rows)   # pboxlint: disable=PB602 -- verb-serialization by design
             else:
                 rows = t.bulk_pull(req["keys"])
             wd = req.get("wire_dtype")
@@ -593,8 +599,10 @@ class PSServer:
             # Non-summable fields (slot, mf_size, beta powers) arrive as
             # absolute values and overwrite.
             t = self._table(req)
+            # Delta-lock + pool fan-out: same deliberate verb-serialization
+            # as the pull_sparse create path above.
             with self._delta_locks[req.get("table") or DEFAULT_TABLE]:
-                cur = t.bulk_pull(req["keys"])
+                cur = t.bulk_pull(req["keys"])   # pboxlint: disable=PB602 -- verb-serialization by design
                 for f, d in req["rows"].items():
                     if f in cur:
                         cur[f] = cur[f] + d
@@ -603,7 +611,7 @@ class PSServer:
                         cur[f] = v
                 if "unseen_days" in cur:
                     cur["unseen_days"] = np.zeros_like(cur["unseen_days"])
-                t.bulk_write(req["keys"], cur)
+                t.bulk_write(req["keys"], cur)   # pboxlint: disable=PB602 -- verb-serialization by design
             return {"ok": True}
         if cmd == "pull_dense":
             with self._dense_lock:
@@ -826,7 +834,7 @@ class _PipelineRun:
 
     def __init__(self, reqs: List[Dict], window: int,
                  retries: Optional[int] = None):
-        self._cv = threading.Condition()
+        self._cv = lockdep.condition("ps.service._PipelineRun._cv")
         self.n = len(reqs)
         self._queue = deque(enumerate(reqs))
         self.results: List[Optional[Dict]] = [None] * self.n
@@ -957,11 +965,11 @@ class PSClient:
         # size a wide table's first chunk past the wire cap.  _lock guards
         # THIS dict and rid allocation only — never network I/O (PB104)
         self._row_bytes_est: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ps.service.PSClient._lock")
         # connection pool: streams check out exclusively via _pool_cv
         self._pool = [_Stream(i) for i in range(self.streams)]
         self._free: List[_Stream] = list(self._pool)
-        self._pool_cv = threading.Condition()
+        self._pool_cv = lockdep.condition("ps.service.PSClient._pool_cv")
         # rid = token ":" seq — unique per client instance, monotonic
         self._token = f"c{os.getpid():x}-{os.urandom(4).hex()}"
         self._seq = 0
@@ -1219,7 +1227,7 @@ class PSClient:
                 continue
 
             pending: "deque[Tuple[int, Dict]]" = deque()
-            cv = threading.Condition()
+            cv = lockdep.condition("ps.service.PSClient._pump_stream.cv")
             state = {"err": None, "done": False, "progress": False}
 
             def receiver(sock=stream.sock, pending=pending, cv=cv,

@@ -21,7 +21,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from paddlebox_tpu.ps import feature_value as fv
-from paddlebox_tpu.utils import workpool
+from paddlebox_tpu.utils import lockdep, workpool
 
 _MAGIC = b"PBOXSSD1"
 
@@ -37,7 +37,7 @@ class SSDShard:
         self.width = len(self.scalar_fields) + mf_dim
         self.row_bytes = 8 + 4 * self.width
         self.index: Dict[int, int] = {}   # key → byte offset of latest row
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ps.ssd_table.SSDShard._lock")
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         if os.path.exists(path):
             self._rebuild_index()

@@ -1,0 +1,620 @@
+"""Whole-package call graph over the AST (foundation for PB6xx).
+
+Indexes every module-level function, every method (decorated defs are
+still plain ``FunctionDef`` nodes), and every nested def/closure into a
+``PackageGraph`` of qualified names (``ps.service.PSClient.pull_sparse``,
+``ps.host_table.ShardedHostTable.bulk_pull.pull_shard``), then resolves
+call sites:
+
+  * ``self.m()`` / ``cls.m()`` through the class hierarchy — the defining
+    class, its package bases, and any package subclass override (CHA-style
+    virtual dispatch).
+  * plain names through local nested defs, module scope, and imports.
+  * ``obj.m()`` through light local type inference: ``x = ClassName(...)``,
+    ``x = self.attr`` / ``for x in self.attr`` where the attr (or its
+    container elements) got a class type in ``__init__``-style assignments.
+  * ``WorkPool``/executor hand-offs — ``pool.submit(f, ...)``,
+    ``pool.map(f, ...)``, ``threading.Thread(target=f)`` — become *spawn*
+    edges to ``f``: the target runs on another thread, so callers' held
+    lock sets must NOT flow into it, but the target is still analyzed as
+    a root of its own.
+  * anything else ``x.m()`` falls back to CHA widening: edges to every
+    package function/method named ``m``.  Unknown targets widen the
+    analysis — they never drop it (lockgraph keeps the caller's held-set
+    across the call either way).
+
+Stdlib-only (`ast`), same contract as the rest of pboxlint.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from paddlebox_tpu.tools.pboxlint.core import Module, dotted_name
+
+# receiver factories whose .submit/.map targets run on a bounded WorkPool;
+# the value is the pool kind used by PB603
+_POOL_FACTORIES = {"table_pool": "table", "pack_pool": "pack"}
+_SPAWN_KEYWORDS = {"target"}          # Thread(target=...), Timer(function=...)
+
+# CHA widening never applies to method names that are overwhelmingly
+# builtin-collection/str/file calls on untyped receivers — widening
+# `d.get(...)` to every package `get` method floods the lock analysis
+# with phantom paths.  Typed receivers still resolve these precisely.
+_WIDEN_SKIP = {
+    "get", "clear", "pop", "append", "add", "update", "items", "keys",
+    "values", "copy", "extend", "remove", "discard", "sort", "reverse",
+    "setdefault", "popitem", "popleft", "count", "index", "join",
+    "split", "strip", "close", "read", "write", "flush", "seek", "tell",
+    "encode", "decode", "format", "startswith", "endswith", "lower",
+    "upper", "replace", "record", "put", "send", "recv", "tolist",
+    "astype", "reshape", "item", "sum", "max", "min", "mean", "fill",
+    # threading/executor primitive names: `evt.wait()`, `t.join()`,
+    # `jax.tree.map(...)`, `httpd.shutdown()` — widening these to
+    # package methods floods the lock analysis; typed receivers (and
+    # the pool factories) still resolve them precisely
+    "map", "submit", "shutdown", "wait", "notify", "notify_all",
+    "set", "is_set", "acquire", "release", "start", "run",
+}
+
+
+def module_name(path: str) -> str:
+    """File path → package-relative dotted module name.
+
+    ``.../paddlebox_tpu/ps/service.py`` → ``ps.service``; paths outside
+    the package (test snippets) use their basename stem.
+    """
+    parts = os.path.normpath(path).split(os.sep)
+    if "paddlebox_tpu" in parts:
+        parts = parts[len(parts) - parts[::-1].index("paddlebox_tpu"):]
+    stem = [p[:-3] if p.endswith(".py") else p for p in parts]
+    stem = [p for p in stem if p] or [os.path.basename(path)]
+    if stem[-1] == "__init__":
+        stem = stem[:-1] or ["__init__"]
+    return ".".join(stem)
+
+
+@dataclasses.dataclass
+class CallSite:
+    line: int
+    name: str                    # terminal call name, for messages
+    targets: Tuple[str, ...]     # resolved function qnames
+    kind: str                    # "call" | "spawn"
+    widened: bool = False        # dynamic-call CHA fallback used
+    pool: Optional[str] = None   # pool kind for WorkPool spawns
+    node: Optional[ast.Call] = dataclasses.field(
+        default=None, repr=False, compare=False)   # the ast call site
+
+
+class FuncInfo:
+    def __init__(self, qname: str, mod: Module, node: ast.AST,
+                 cls: Optional["ClassInfo"], self_name: Optional[str]):
+        self.qname = qname
+        self.mod = mod
+        self.node = node
+        self.cls = cls              # enclosing class (closures keep it)
+        self.self_name = self_name  # receiver arg name, None for functions
+        self.calls: List[CallSite] = []    # filled by PackageGraph.resolve
+
+    def __repr__(self) -> str:      # pragma: no cover - debugging aid
+        return f"<Func {self.qname}>"
+
+
+class ClassInfo:
+    def __init__(self, qname: str, node: ast.ClassDef, mod: Module):
+        self.qname = qname
+        self.name = node.name
+        self.node = node
+        self.mod = mod
+        self.methods: Dict[str, FuncInfo] = {}
+        self.base_names: List[str] = [dotted_name(b) for b in node.bases]
+        self.bases: List[str] = []        # package base qnames, resolved
+        self.subclasses: Set[str] = set()
+        self.attr_types: Dict[str, str] = {}   # self.X = Cls() → X: qname
+        self.elem_types: Dict[str, str] = {}   # self.X = [Cls()...] / .append
+
+
+class PackageGraph:
+    """Index + resolver over a set of parsed modules."""
+
+    def __init__(self, modules: Sequence[Module]):
+        self.modules = list(modules)
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.by_method_name: Dict[str, List[str]] = {}
+        self.class_by_name: Dict[str, List[str]] = {}
+        # per-module: local name → qname it refers to (imports + defs)
+        self._scope: Dict[str, Dict[str, str]] = {}
+        for mod in self.modules:
+            self._index_module(mod)
+        self._link_classes()
+        for cls in self.classes.values():
+            self._infer_attr_types(cls)
+        # module-global var → class qname (`_POOL = WorkPool(...)` under a
+        # `global _POOL` decl, or a module-level ctor assignment)
+        self.global_types: Dict[str, Dict[str, str]] = {}
+        for mod in self.modules:
+            self.global_types[mod.path] = self._infer_global_types(mod)
+        for fn in list(self.functions.values()):
+            fn.calls = list(self._resolve_calls(fn))
+
+    # ------------------------------------------------------------- indexing
+    def _index_module(self, mod: Module) -> None:
+        modname = module_name(mod.path)
+        scope = self._scope.setdefault(mod.path, {})
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    scope[alias.asname or alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(stmt, ast.ImportFrom) and stmt.module:
+                for alias in stmt.names:
+                    scope[alias.asname or alias.name] = \
+                        f"{stmt.module}.{alias.name}"
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_function(mod, stmt, f"{modname}.{stmt.name}",
+                                     None, None)
+                scope[stmt.name] = f"{modname}.{stmt.name}"
+            elif isinstance(stmt, ast.ClassDef):
+                qname = f"{modname}.{stmt.name}"
+                cls = ClassInfo(qname, stmt, mod)
+                self.classes[qname] = cls
+                self.class_by_name.setdefault(stmt.name, []).append(qname)
+                scope[stmt.name] = qname
+                for m in stmt.body:
+                    if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self_name = (m.args.args[0].arg
+                                     if m.args.args else None)
+                        fi = self._index_function(
+                            mod, m, f"{qname}.{m.name}", cls, self_name)
+                        cls.methods[m.name] = fi
+                        self.by_method_name.setdefault(
+                            m.name, []).append(fi.qname)
+
+    def _index_function(self, mod: Module, node, qname: str,
+                        cls: Optional[ClassInfo],
+                        self_name: Optional[str]) -> FuncInfo:
+        fi = FuncInfo(qname, mod, node, cls, self_name)
+        self.functions[qname] = fi
+        # index direct nested defs (each recursion handles its own nesting)
+        stack: List[ast.AST] = list(node.body)
+        while stack:
+            child = stack.pop()
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_function(mod, child, f"{qname}.{child.name}",
+                                     cls, self_name)
+                continue
+            if isinstance(child, ast.Lambda):
+                continue
+            stack.extend(ast.iter_child_nodes(child))
+        return fi
+
+    def _link_classes(self) -> None:
+        for cls in self.classes.values():
+            scope = self._scope.get(cls.mod.path, {})
+            for base in cls.base_names:
+                head = base.split(".", 1)[0]
+                resolved = None
+                if base in self.classes:
+                    resolved = base
+                elif scope.get(base) in self.classes:
+                    resolved = scope[base]
+                elif head in scope:
+                    # module alias: `hb.Base` with `import x as hb`
+                    tail = base.split(".", 1)[1] if "." in base else ""
+                    for cand in self.class_by_name.get(
+                            tail.rsplit(".", 1)[-1], []):
+                        resolved = cand
+                        break
+                elif base.rsplit(".", 1)[-1] in self.class_by_name:
+                    cands = self.class_by_name[base.rsplit(".", 1)[-1]]
+                    if len(cands) == 1:
+                        resolved = cands[0]
+                if resolved:
+                    cls.bases.append(resolved)
+                    self.classes[resolved].subclasses.add(cls.qname)
+
+    # ------------------------------------------------- attribute type model
+    def _class_from_ctor(self, mod: Module, call: ast.AST) -> Optional[str]:
+        if not isinstance(call, ast.Call):
+            return None
+        name = dotted_name(call.func)
+        if not name:
+            return None
+        scope = self._scope.get(mod.path, {})
+        if name in self.classes:
+            return name
+        if scope.get(name) in self.classes:
+            return scope[name]
+        tail = name.rsplit(".", 1)[-1]
+        cands = self.class_by_name.get(tail, [])
+        if len(cands) == 1 and (tail[:1].isupper() or "." in name):
+            return cands[0]
+        return None
+
+    def _infer_attr_types(self, cls: ClassInfo) -> None:
+        for fi in cls.methods.values():
+            self_name = fi.self_name or "self"
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == self_name):
+                        ctor = self._class_from_ctor(cls.mod, node.value)
+                        if ctor:
+                            cls.attr_types[t.attr] = ctor
+                            continue
+                        elem = self._container_elem(cls.mod, node.value)
+                        if elem:
+                            cls.elem_types[t.attr] = elem
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("append", "add") \
+                        and node.args:
+                    recv = node.func.value
+                    if (isinstance(recv, ast.Attribute)
+                            and isinstance(recv.value, ast.Name)
+                            and recv.value.id == self_name):
+                        ctor = self._class_from_ctor(cls.mod, node.args[0])
+                        if ctor:
+                            cls.elem_types.setdefault(recv.attr, ctor)
+
+    @staticmethod
+    def _assign_pairs(node: ast.Assign):
+        """(target, value) pairs, unpacking `a, b = x, y` pairwise."""
+        for t in node.targets:
+            if isinstance(t, ast.Tuple) and isinstance(node.value,
+                                                       ast.Tuple) \
+                    and len(t.elts) == len(node.value.elts):
+                for tt, vv in zip(t.elts, node.value.elts):
+                    yield tt, vv
+            else:
+                yield t, node.value
+
+    def _infer_global_types(self, mod: Module) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t, v in self._assign_pairs(stmt):
+                    if isinstance(t, ast.Name):
+                        ctor = self._class_from_ctor(mod, v)
+                        if ctor:
+                            out[t.id] = ctor
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            gnames: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Global):
+                    gnames.update(sub.names)
+            if not gnames:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    for t, v in self._assign_pairs(sub):
+                        if isinstance(t, ast.Name) and t.id in gnames:
+                            ctor = self._class_from_ctor(mod, v)
+                            if ctor:
+                                out.setdefault(t.id, ctor)
+        return out
+
+    def _container_elem(self, mod: Module, node: ast.AST) -> Optional[str]:
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            for elt in node.elts:
+                ctor = self._class_from_ctor(mod, elt)
+                if ctor:
+                    return ctor
+        elif isinstance(node, (ast.ListComp, ast.SetComp)):
+            return self._class_from_ctor(mod, node.elt)
+        elif isinstance(node, ast.DictComp):
+            return self._class_from_ctor(mod, node.value)
+        elif isinstance(node, ast.Dict):
+            for v in node.values:
+                ctor = self._class_from_ctor(mod, v)
+                if ctor:
+                    return ctor
+        return None
+
+    # ----------------------------------------------------- call resolution
+    def _method_targets(self, cls_q: str, meth: str,
+                        virtual: bool = True) -> List[str]:
+        """Resolve `meth` on class `cls_q`: defining class or nearest base,
+        plus subclass overrides (virtual dispatch)."""
+        out: List[str] = []
+        seen: Set[str] = set()
+        stack = [cls_q]
+        while stack:                     # walk up the bases for the def
+            q = stack.pop(0)
+            if q in seen or q not in self.classes:
+                continue
+            seen.add(q)
+            cls = self.classes[q]
+            if meth in cls.methods:
+                out.append(cls.methods[meth].qname)
+                break
+            stack.extend(cls.bases)
+        if virtual:                      # and down for overrides
+            stack = list(self.classes.get(cls_q).subclasses
+                         if cls_q in self.classes else [])
+            while stack:
+                q = stack.pop()
+                if q in seen or q not in self.classes:
+                    continue
+                seen.add(q)
+                cls = self.classes[q]
+                if meth in cls.methods:
+                    out.append(cls.methods[meth].qname)
+                stack.extend(cls.subclasses)
+        return out
+
+    def _local_types(self, fn: FuncInfo) -> Dict[str, str]:
+        """var name → class qname, from ctor assignments and typed-attr
+        aliases/iteration within this one function body."""
+        out: Dict[str, str] = dict(
+            self.global_types.get(fn.mod.path, {}))
+        cls = fn.cls
+        self_name = fn.self_name
+
+        def attr_type(node: ast.AST) -> Optional[str]:
+            while isinstance(node, ast.Subscript):
+                node = node.value
+            if (cls is not None and isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == self_name):
+                return cls.attr_types.get(node.attr)
+            return None
+
+        def elem_type(node: ast.AST) -> Optional[str]:
+            base = node
+            if isinstance(base, ast.Call):     # e.g. list(self._shards)
+                base = base.args[0] if base.args else base
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if (cls is not None and isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == self_name):
+                return cls.elem_types.get(base.attr)
+            if isinstance(base, ast.Name) and base.id in out:
+                return None
+            return None
+
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign):
+                for tgt, val in self._assign_pairs(node):
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    var = tgt.id
+                    ctor = self._class_from_ctor(fn.mod, val)
+                    if ctor:
+                        out[var] = ctor
+                        continue
+                    at = attr_type(val)
+                    if at:
+                        out[var] = at
+                        continue
+                    if isinstance(val, ast.Name) and val.id in out:
+                        out[var] = out[val.id]      # alias copy
+                        continue
+                    # x = self._shards[i] → element type
+                    if isinstance(val, ast.Subscript):
+                        et = elem_type(val.value)
+                        if et:
+                            out[var] = et
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                tgt = node.target
+                it = node.iter
+                if isinstance(tgt, ast.Name):
+                    et = elem_type(it)
+                    if et:
+                        out[tgt.id] = et
+        return out
+
+    def _value_targets(self, fn: FuncInfo, node: ast.AST,
+                       local_types: Dict[str, str]) -> List[str]:
+        """Resolve a *value reference* (callback arg) to function qnames."""
+        if isinstance(node, ast.Name):
+            nested = f"{fn.qname}.{node.id}"
+            if nested in self.functions:
+                return [nested]
+            scope = self._scope.get(fn.mod.path, {})
+            q = scope.get(node.id)
+            if q in self.functions:
+                return [q]
+            if q in self.classes or node.id in self.classes:
+                cq = q if q in self.classes else node.id
+                return self._method_targets(cq, "__init__", virtual=False)
+        elif isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name):
+                if base.id == fn.self_name and fn.cls is not None:
+                    return self._method_targets(fn.cls.qname, node.attr)
+                bq = local_types.get(base.id)
+                if bq:
+                    return self._method_targets(bq, node.attr)
+            # CHA fallback for bound-method references
+            if node.attr in _WIDEN_SKIP:
+                return []
+            return [q for q in self.by_method_name.get(node.attr, [])]
+        elif isinstance(node, ast.Lambda):
+            return []          # lambda bodies are walked inline by callers
+        return []
+
+    def _resolve_calls(self, fn: FuncInfo):
+        local_types = self._local_types(fn)
+        scope = self._scope.get(fn.mod.path, {})
+        modname = module_name(fn.mod.path)
+
+        own_body: List[ast.AST] = []
+        for stmt in fn.node.body:
+            own_body.append(stmt)
+
+        def walk_own(nodes):
+            """Yield nodes of this function body, not nested defs."""
+            stack = list(nodes)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                yield node
+                stack.extend(ast.iter_child_nodes(node))
+
+        for node in walk_own(own_body):
+            if not isinstance(node, ast.Call):
+                continue
+            site = self._resolve_one(fn, node, local_types, scope, modname)
+            if site is not None:
+                site.node = node
+                yield site
+
+    def _ctor_pool_kind(self, call: ast.Call) -> Optional[str]:
+        """`table_pool()` / `pack_pool()` / `WorkPool(n, kind=...)` →
+        the pool kind, None for any other call."""
+        tail = dotted_name(call.func).rsplit(".", 1)[-1]
+        if tail in _POOL_FACTORIES:
+            return _POOL_FACTORIES[tail]
+        if tail == "WorkPool":
+            for kw in call.keywords:
+                if kw.arg == "kind" and isinstance(kw.value, ast.Constant):
+                    return str(kw.value.value)
+            if len(call.args) > 1 and isinstance(call.args[1], ast.Constant):
+                return str(call.args[1].value)
+            return "table"              # WorkPool's default kind
+        return None
+
+    def _fn_pool_kinds(self, fn: FuncInfo) -> Dict[str, str]:
+        """var name → pool kind, for locals assigned from a pool factory
+        or WorkPool ctor anywhere in this function (`pool = pack_pool()`
+        then `pool.submit(...)` must still be a spawn edge)."""
+        cached = getattr(fn, "_pool_kinds", None)
+        if cached is not None:
+            return cached
+        out: Dict[str, str] = {}
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign):
+                for tgt, val in self._assign_pairs(node):
+                    if isinstance(tgt, ast.Name) and isinstance(val,
+                                                                ast.Call):
+                        kind = self._ctor_pool_kind(val)
+                        if kind is not None:
+                            out[tgt.id] = kind
+        fn._pool_kinds = out
+        return out
+
+    def _pool_kind(self, fn: FuncInfo, recv: ast.AST,
+                   local_types: Dict[str, str]) -> Optional[str]:
+        """Is `recv` a WorkPool?  → pool kind ("table"/"pack"/"?")."""
+        if isinstance(recv, ast.Call):
+            return self._ctor_pool_kind(recv)
+        if isinstance(recv, ast.Name):
+            kind = self._fn_pool_kinds(fn).get(recv.id)
+            if kind is not None:
+                return kind
+            t = local_types.get(recv.id)
+            if t and t.rsplit(".", 1)[-1] == "WorkPool":
+                return "?"
+        if isinstance(recv, ast.Attribute):
+            base = recv.value
+            if isinstance(base, ast.Name) and base.id == fn.self_name \
+                    and fn.cls is not None:
+                t = fn.cls.attr_types.get(recv.attr)
+                if t and t.rsplit(".", 1)[-1] == "WorkPool":
+                    return "?"
+        return None
+
+    def _resolve_one(self, fn: FuncInfo, node: ast.Call,
+                     local_types: Dict[str, str], scope: Dict[str, str],
+                     modname: str) -> Optional[CallSite]:
+        func = node.func
+        # -- spawn edges: Thread(target=f) / pool.submit(f) / pool.map(f)
+        ctor_name = dotted_name(func).rsplit(".", 1)[-1]
+        if ctor_name in ("Thread", "Timer"):
+            for kw in node.keywords:
+                if kw.arg in _SPAWN_KEYWORDS:
+                    targets = self._value_targets(fn, kw.value, local_types)
+                    if targets:
+                        return CallSite(node.lineno, "Thread",
+                                        tuple(sorted(targets)), "spawn")
+            return None
+        if isinstance(func, ast.Attribute) and func.attr in ("submit",
+                                                             "map"):
+            pool = self._pool_kind(fn, func.value, local_types)
+            if pool is not None and node.args:
+                targets = self._value_targets(fn, node.args[0], local_types)
+                return CallSite(node.lineno, func.attr,
+                                tuple(sorted(targets)), "spawn", pool=pool)
+
+        # -- synchronous calls
+        if isinstance(func, ast.Name):
+            name = func.id
+            nested = f"{fn.qname}.{name}"
+            if nested in self.functions:
+                return CallSite(node.lineno, name, (nested,), "call")
+            q = scope.get(name)
+            if q is None and f"{modname}.{name}" in self.functions:
+                q = f"{modname}.{name}"
+            if q in self.functions:
+                return CallSite(node.lineno, name, (q,), "call")
+            if q in self.classes:
+                ctor = self._method_targets(q, "__init__", virtual=False)
+                if ctor:
+                    return CallSite(node.lineno, name, tuple(ctor), "call")
+            return None
+
+        if isinstance(func, ast.Attribute):
+            meth = func.attr
+            base = func.value
+            # self.m() / cls.m()
+            if isinstance(base, ast.Name) and fn.cls is not None \
+                    and base.id == fn.self_name:
+                targets = self._method_targets(fn.cls.qname, meth)
+                if targets:
+                    return CallSite(node.lineno, meth,
+                                    tuple(sorted(targets)), "call")
+                return None
+            # module.f() via imports
+            dn = dotted_name(func)
+            if dn:
+                head = dn.split(".", 1)[0]
+                if head in scope:
+                    q = scope[head] + dn[len(head):]
+                    if q in self.functions:
+                        return CallSite(node.lineno, meth, (q,), "call")
+                    if q in self.classes:
+                        ctor = self._method_targets(q, "__init__",
+                                                    virtual=False)
+                        if ctor:
+                            return CallSite(node.lineno, meth,
+                                            tuple(ctor), "call")
+            # typed receiver: x.m() / self.attr.m()
+            recv_cls: Optional[str] = None
+            if isinstance(base, ast.Name):
+                recv_cls = local_types.get(base.id)
+            elif isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == fn.self_name and fn.cls is not None:
+                recv_cls = fn.cls.attr_types.get(base.attr)
+            elif isinstance(base, ast.Subscript):
+                inner = base.value
+                if isinstance(inner, ast.Attribute) \
+                        and isinstance(inner.value, ast.Name) \
+                        and inner.value.id == fn.self_name \
+                        and fn.cls is not None:
+                    recv_cls = fn.cls.elem_types.get(inner.attr)
+            if recv_cls:
+                targets = self._method_targets(recv_cls, meth)
+                if targets:
+                    return CallSite(node.lineno, meth,
+                                    tuple(sorted(targets)), "call")
+            # CHA widening: any package method with this name
+            cands = (self.by_method_name.get(meth, [])
+                     if meth not in _WIDEN_SKIP else [])
+            if cands:
+                return CallSite(node.lineno, meth, tuple(sorted(cands)),
+                                "call", widened=True)
+        return None

@@ -67,18 +67,38 @@ class PackageContext:
     def __init__(self, modules: Sequence[Module]):
         self.modules = list(modules)
         self.defined_flags: Set[str] = set()
+        # flag name → (path, line) of its define_flag site
+        self.flag_def_sites: Dict[str, Tuple[str, int]] = {}
+        self.read_flags: Set[str] = set()   # get_flags/set_flags literals
         self.dynamic_flag_defs = False    # define_flag with non-literal name
+        self.dynamic_flag_reads = False   # get_flags with non-literal name
         for mod in self.modules:
             for node in ast.walk(mod.tree):
-                if (isinstance(node, ast.Call)
-                        and _call_name(node).endswith("define_flag")
-                        and node.args):
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = _call_name(node).rsplit(".", 1)[-1]
+                if tail == "define_flag" and node.args:
                     arg = node.args[0]
                     if isinstance(arg, ast.Constant) \
                             and isinstance(arg.value, str):
                         self.defined_flags.add(arg.value)
+                        self.flag_def_sites.setdefault(
+                            arg.value, (mod.path, node.lineno))
                     else:
                         self.dynamic_flag_defs = True
+                elif tail == "get_flags" and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str):
+                        self.read_flags.add(arg.value)
+                    else:
+                        self.dynamic_flag_reads = True
+                elif tail == "set_flags" and node.args \
+                        and isinstance(node.args[0], ast.Dict):
+                    for k in node.args[0].keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            self.read_flags.add(k.value)
 
 
 def _call_name(node: ast.Call) -> str:
@@ -116,11 +136,12 @@ def ALL_CHECKERS():
     # local import: checker modules import core for helpers
     from paddlebox_tpu.tools.pboxlint import (atomic_io, device_cache,
                                               flags_hygiene, flight_events,
-                                              lifecycle, locks, metric_names,
-                                              purity, retries)
+                                              lifecycle, lockgraph, locks,
+                                              metric_names, purity, retries)
     return (locks.check, flags_hygiene.check, metric_names.check,
             flight_events.check, purity.check, lifecycle.check,
-            retries.check, atomic_io.check, device_cache.check)
+            retries.check, atomic_io.check, device_cache.check,
+            lockgraph.check)
 
 
 def lint_modules(modules: Sequence[Module]) -> List[Finding]:
@@ -156,21 +177,122 @@ def lint_source(source: str, path: str = "<snippet>",
     return [f for f in lint_modules(mods) if f.path == path]
 
 
+def baseline_counts(findings: Sequence[Finding]) -> Dict[str, int]:
+    """Findings → {"path:code": count} — the baseline-diff key.  Line
+    numbers and messages churn on every edit, so the diff is keyed on
+    per-file per-code counts: a PR that *adds* a finding of some code to
+    a file fails; moving or rewording existing ones does not."""
+    out: Dict[str, int] = {}
+    for f in findings:
+        key = f"{f.path}:{f.code}"
+        out[key] = out.get(key, 0) + 1
+    return out
+
+
+_USAGE = """\
+usage: python -m paddlebox_tpu.tools.pboxlint [options] <file-or-dir> [...]
+
+options:
+  --format=text|json   output format (json: {findings, errors, counts})
+  --baseline FILE      compare against a saved baseline (json produced by
+                       --format=json, or just its "counts" object); exit 1
+                       only on findings NEW relative to the baseline
+  --write-baseline FILE
+                       write the current per-file/per-code counts to FILE
+                       (and exit by the normal rules)
+
+exit codes:
+  0  clean (or, with --baseline, no new findings)
+  1  findings (with --baseline: at least one new finding bucket)
+  2  parse/usage errors (a file that does not parse is never clean)
+"""
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    import json
+
     args = list(sys.argv[1:] if argv is None else argv)
     if not args or "-h" in args or "--help" in args:
         print(__doc__)
-        print("usage: python -m paddlebox_tpu.tools.pboxlint "
-              "<file-or-dir> [...]")
+        print(_USAGE)
         return 0 if args else 2
-    findings, errors = lint_paths(args)
-    for path, err in errors:
-        print(f"{path}:0: PB000 parse failure: {err}")
-    for f in findings:
-        print(f.render())
+    fmt = "text"
+    baseline_path: Optional[str] = None
+    write_baseline: Optional[str] = None
+    paths: List[str] = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a.startswith("--format="):
+            fmt = a.split("=", 1)[1]
+            if fmt not in ("text", "json"):
+                print(f"pboxlint: unknown format {fmt!r}", file=sys.stderr)
+                return 2
+        elif a == "--baseline" and i + 1 < len(args):
+            i += 1
+            baseline_path = args[i]
+        elif a == "--write-baseline" and i + 1 < len(args):
+            i += 1
+            write_baseline = args[i]
+        elif a.startswith("--"):
+            print(_USAGE, file=sys.stderr)
+            return 2
+        else:
+            paths.append(a)
+        i += 1
+    if not paths:
+        print(_USAGE, file=sys.stderr)
+        return 2
+
+    findings, errors = lint_paths(paths)
+    counts = baseline_counts(findings)
+
+    new_keys: List[str] = []
+    if baseline_path is not None:
+        try:
+            with open(baseline_path, encoding="utf-8") as f:
+                base = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"pboxlint: cannot read baseline {baseline_path}: {e!r}",
+                  file=sys.stderr)
+            return 2
+        base_counts = base.get("counts", base)
+        if not isinstance(base_counts, dict):
+            print("pboxlint: baseline has no counts object",
+                  file=sys.stderr)
+            return 2
+        new_keys = sorted(k for k, n in counts.items()
+                          if n > int(base_counts.get(k, 0)))
+
+    if fmt == "json":
+        print(json.dumps({
+            "findings": [dataclasses.asdict(f) for f in findings],
+            "errors": [{"path": p, "error": e} for p, e in errors],
+            "counts": counts,
+            "new": new_keys,
+        }, indent=2, sort_keys=True))
+    else:
+        for path, err in errors:
+            print(f"{path}:0: PB000 parse failure: {err}")
+        for f in findings:
+            print(f.render())
+
+    if write_baseline is not None:
+        with open(write_baseline, "w", encoding="utf-8") as f:
+            json.dump({"counts": counts}, f, indent=2, sort_keys=True)
+
     if errors:
         return 2
+    if baseline_path is not None:
+        if new_keys:
+            if fmt != "json":
+                for k in new_keys:
+                    print(f"pboxlint: NEW vs baseline: {k}")
+                print(f"pboxlint: {len(new_keys)} new finding bucket(s)")
+            return 1
+        return 0
     if findings:
-        print(f"pboxlint: {len(findings)} finding(s)")
+        if fmt != "json":
+            print(f"pboxlint: {len(findings)} finding(s)")
         return 1
     return 0

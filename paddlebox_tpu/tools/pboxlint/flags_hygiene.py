@@ -11,6 +11,12 @@
   PB203  raw ``os.environ["FLAGS_..."]`` / ``os.getenv("FLAGS_...")``
          access outside flags.py — bypasses the registry (no defaults, no
          coercion, no set_flags visibility).
+  PB205  a flag is registered via ``define_flag`` but never read by a
+         literal ``get_flags("name")`` (or set by a literal ``set_flags``
+         key) anywhere in the linted set — a dead knob: env overrides and
+         launcher exports of it silently change nothing.  Skipped when
+         any ``get_flags`` call uses a non-literal name (the reads are
+         then out of static reach).
 """
 
 from __future__ import annotations
@@ -77,6 +83,16 @@ def check(mod: Module, ctx: PackageContext) -> List[Finding]:
                             f"flag — KeyError at runtime"))
 
         elif tail == "define_flag" and len(node.args) >= 2:
+            name_node = node.args[0]
+            if (isinstance(name_node, ast.Constant)
+                    and isinstance(name_node.value, str)
+                    and name_node.value not in ctx.read_flags
+                    and not ctx.dynamic_flag_reads):
+                findings.append(Finding(
+                    mod.path, node.lineno, "PB205",
+                    f"flag {name_node.value!r} is defined but never read "
+                    f"via get_flags anywhere in the linted set — dead "
+                    f"knob (env/launcher overrides of it do nothing)"))
             default_node = node.args[1]
             default = _literal(default_node)
             if default is None and not (

@@ -1,0 +1,555 @@
+"""PB6xx — interprocedural lock-order analysis (lockgraph).
+
+Propagates held-lock sets along the whole-package call graph
+(``callgraph.PackageGraph``).  Locks are named by *class-level*
+fingerprints — ``ps.service.PSClient._lock``, ``ps.host_table._Shard.lock``,
+``utils.workpool._POOL_LOCK`` — so every instance of a class shares one
+node, exactly like Linux lockdep's lock classes.  When a lock is created
+through the ``utils.lockdep`` factories the literal name argument *is*
+the fingerprint, which keeps the static graph and the runtime witness
+(``lockdep.edges()``) in the same namespace; the tier-1 cross-validation
+soak asserts runtime ⊆ static.
+
+  PB601  lock-order inversion: two lock classes acquirable in both
+         orders on different paths (potential ABBA deadlock).  Ordering
+         edges come from nested ``with`` blocks *and* from call chains —
+         holding A while calling a function that (transitively) takes B
+         adds A→B.  ``WorkPool.submit``/``map`` hand-offs ALSO order:
+         the pool runs tasks inline on the submitting thread (one
+         worker, one item, re-entrant fan-out), so a pool task's locks
+         can really nest inside the submitter's.  ``Thread(target=)``
+         never runs inline — the caller's held-set does not flow into
+         it (it is analyzed as a root of its own).
+  PB602  blocking call reachable *transitively* while a lock is held —
+         the interprocedural generalization of PB104 (which only sees
+         the same function).  Blocking primitives: socket/file I/O and
+         the package frame helpers (PB104's set), ``Condition.wait``,
+         ``Future.result`` and ``WorkPool.map`` submit-and-wait.  A
+         blocking site carrying a PB104/PB602 suppression in its own
+         module is a vetted design — it does not propagate.
+  PB603  a task submitted to a bounded ``WorkPool`` that can re-enter a
+         pool of the same kind (submit-and-wait from inside the pool
+         starves the fixed worker set; the inline re-entrant path in
+         ``WorkPool.map`` exists precisely because of this).
+  PB604  untimed ``Condition.wait()`` outside a ``while`` predicate
+         loop — wakeups are advisory (spurious wakeup / missed
+         predicate).  ``wait(timeout)`` outside a loop is an
+         interruptible sleep and is fine.
+
+Unknown call targets *widen* the analysis (CHA fallback to every
+same-named package method) — the caller's held-set is never dropped.
+To keep the widening from flooding PB601/PB602 with phantom paths,
+widened edges only propagate when the callee name is unique enough
+(< _WIDEN_FANOUT_CAP candidates).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from paddlebox_tpu.tools.pboxlint import callgraph
+from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
+                                               PackageContext, dotted_name)
+from paddlebox_tpu.tools.pboxlint.locks import _BLOCKING_IO
+
+_LOCK_FACTORIES = {"Lock": False, "RLock": False, "Condition": True}
+_LOCKDEP_FACTORIES = {"lock": False, "rlock": False, "condition": True}
+_WIDEN_FANOUT_CAP = 4     # CHA fallback fans out to at most this many
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDef:
+    fp: str               # class-level fingerprint ("ps.service.PSClient._lock")
+    is_condition: bool
+
+
+@dataclasses.dataclass
+class _Summary:
+    """Per-function facts (own body only, nested defs excluded)."""
+    fn: "callgraph.FuncInfo"
+    acquires: List[Tuple[str, int, Tuple[str, ...]]]          # (fp, line, held)
+    call_held: Dict[int, Tuple[str, ...]]                     # id(ast.Call) → held
+    blocking: List[Tuple[str, int]]                           # (desc, line)
+    waits: List[Tuple[str, int, bool]]                        # (fp, line, in_while)
+    pool_uses: List[Tuple[str, int]]                          # (pool kind, line)
+
+
+class LockAnalysis:
+    """Whole-package result: ordering edges, summaries, findings."""
+
+    def __init__(self, graph: callgraph.PackageGraph):
+        self.graph = graph
+        self.class_locks: Dict[str, Dict[str, LockDef]] = {}
+        self.module_locks: Dict[str, Dict[str, LockDef]] = {}
+        self.local_locks: Dict[str, Dict[str, LockDef]] = {}
+        self._discover_locks()
+        self.summaries: Dict[str, _Summary] = {
+            q: self._summarize(fn) for q, fn in graph.functions.items()}
+        self.acq: Dict[str, Set[str]] = {}
+        self.blk: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        self._fixpoint()
+        # ordering edges: (from_fp, to_fp) → first witness (path, line, note)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        self._build_edges()
+        self.findings: List[Finding] = []
+        self._check_pb601()
+        self._check_pb602()
+        self._check_pb603()
+        self._check_pb604()
+
+    # ---------------------------------------------------- lock discovery
+    def _lock_def_from_ctor(self, call: ast.AST,
+                            default_fp: str) -> Optional[LockDef]:
+        """threading.Lock/RLock/Condition or lockdep.lock/rlock/condition
+        (literal first arg wins the fingerprint) → LockDef."""
+        if not isinstance(call, ast.Call):
+            return None
+        name = dotted_name(call.func)
+        tail = name.rsplit(".", 1)[-1]
+        if tail in _LOCK_FACTORIES and (
+                "." not in name or name.startswith("threading.")):
+            return LockDef(default_fp, _LOCK_FACTORIES[tail])
+        if tail in _LOCKDEP_FACTORIES and name.startswith("lockdep."):
+            fp = default_fp
+            if call.args and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, str):
+                fp = call.args[0].value
+            return LockDef(fp, _LOCKDEP_FACTORIES[tail])
+        return None
+
+    def _find_ctor(self, value: ast.AST,
+                   default_fp: str) -> Optional[LockDef]:
+        """The value may *be* a lock ctor or *contain* one (dict/list of
+        locks share the container's fingerprint)."""
+        for node in ast.walk(value):
+            ld = self._lock_def_from_ctor(node, default_fp)
+            if ld is not None:
+                return ld
+        return None
+
+    def _condition_alias(self, call: ast.AST, fn_cls, self_name,
+                         locks: Dict[str, LockDef]) -> Optional[str]:
+        """`Condition(self.X)` shares X's underlying lock → alias fp."""
+        if not (isinstance(call, ast.Call) and call.args):
+            return None
+        tail = dotted_name(call.func).rsplit(".", 1)[-1]
+        if tail not in ("Condition", "condition"):
+            return None
+        arg = call.args[0]
+        if (isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id == self_name and arg.attr in locks):
+            return locks[arg.attr].fp
+        return None
+
+    def _discover_locks(self) -> None:
+        g = self.graph
+        for cq, cls in g.classes.items():
+            locks: Dict[str, LockDef] = {}
+            # class-level assigns
+            for stmt in cls.node.body:
+                if isinstance(stmt, ast.Assign):
+                    ld = None
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            ld = self._find_ctor(stmt.value,
+                                                 f"{cq}.{t.id}")
+                            if ld:
+                                locks[t.id] = ld
+            # instance assigns — two passes so Condition(self.X) aliases
+            for _pass in (0, 1):
+                for fi in cls.methods.values():
+                    self_name = fi.self_name or "self"
+                    for node in ast.walk(fi.node):
+                        if not (isinstance(node, ast.Assign)
+                                and len(node.targets) >= 1):
+                            continue
+                        for t in node.targets:
+                            if not (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == self_name):
+                                continue
+                            alias = self._condition_alias(
+                                node.value, cls, self_name, locks)
+                            if alias:
+                                locks[t.attr] = LockDef(alias, True)
+                                continue
+                            ld = self._find_ctor(node.value,
+                                                 f"{cq}.{t.attr}")
+                            if ld:
+                                locks.setdefault(t.attr, ld)
+            self.class_locks[cq] = locks
+        for mod in g.modules:
+            modname = callgraph.module_name(mod.path)
+            mlocks: Dict[str, LockDef] = {}
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            ld = self._find_ctor(stmt.value,
+                                                 f"{modname}.{t.id}")
+                            if ld:
+                                mlocks[t.id] = ld
+            self.module_locks[modname] = mlocks
+        for q, fn in g.functions.items():
+            flocks: Dict[str, LockDef] = {}
+            for node in self._own_body_walk(fn.node):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            ld = self._find_ctor(node.value,
+                                                 f"{q}.{t.id}")
+                            if ld:
+                                flocks[t.id] = ld
+            if flocks:
+                self.local_locks[q] = flocks
+
+    @staticmethod
+    def _own_body_walk(fn_node) -> Iterable[ast.AST]:
+        stack = list(fn_node.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # ------------------------------------------------ fingerprint lookup
+    def _class_lock(self, cq: str, attr: str) -> Optional[LockDef]:
+        """Lock attr on class `cq`, searching package bases too."""
+        seen: Set[str] = set()
+        stack = [cq]
+        while stack:
+            q = stack.pop(0)
+            if q in seen:
+                continue
+            seen.add(q)
+            ld = self.class_locks.get(q, {}).get(attr)
+            if ld is not None:
+                return ld
+            stack.extend(self.graph.classes[q].bases
+                         if q in self.graph.classes else [])
+        return None
+
+    def _lock_expr(self, fn: "callgraph.FuncInfo", expr: ast.AST,
+                   local_types: Dict[str, str]) -> Optional[LockDef]:
+        node = expr
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name):
+            base = node.value.id
+            if fn.cls is not None and base == fn.self_name:
+                return self._class_lock(fn.cls.qname, node.attr)
+            t = local_types.get(base)
+            if t:
+                return self._class_lock(t, node.attr)
+            return None
+        if isinstance(node, ast.Name):
+            # local lock in this function or an enclosing closure scope
+            q = fn.qname
+            while q:
+                ld = self.local_locks.get(q, {}).get(node.id)
+                if ld is not None:
+                    return ld
+                q = q.rsplit(".", 1)[0] if "." in q else ""
+            modname = callgraph.module_name(fn.mod.path)
+            return self.module_locks.get(modname, {}).get(node.id)
+        return None
+
+    # --------------------------------------------------- per-fn summary
+    def _summarize(self, fn: "callgraph.FuncInfo") -> _Summary:
+        local_types = self.graph._local_types(fn)
+        analysis = self
+        suppressions = fn.mod.suppressions
+        summary = _Summary(fn, [], {}, [], [], [])
+        call_by_id = {id(cs.node): cs for cs in fn.calls
+                      if cs.node is not None}
+
+        def suppressed_here(line: int, codes: Tuple[str, ...]) -> bool:
+            s = suppressions.get(line, set())
+            return "ALL" in s or any(c in s for c in codes)
+
+        class W(ast.NodeVisitor):
+            def __init__(self):
+                self.held: List[str] = []
+                self.while_depth = 0
+
+            def _ld(self, expr) -> Optional[LockDef]:
+                return analysis._lock_expr(fn, expr, local_types)
+
+            def visit_With(self, node: ast.With) -> None:
+                n = 0
+                for item in node.items:
+                    ld = self._ld(item.context_expr)
+                    if ld is None:
+                        self.visit(item.context_expr)
+                    else:
+                        summary.acquires.append(
+                            (ld.fp, item.context_expr.lineno,
+                             tuple(self.held)))
+                        self.held.append(ld.fp)
+                        n += 1
+                for stmt in node.body:
+                    self.visit(stmt)
+                if n:
+                    del self.held[len(self.held) - n:]
+
+            visit_AsyncWith = visit_With
+
+            def visit_While(self, node: ast.While) -> None:
+                self.while_depth += 1
+                self.generic_visit(node)
+                self.while_depth -= 1
+
+            def visit_FunctionDef(self, node) -> None:
+                pass               # nested defs are their own summaries
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+            visit_Lambda = visit_FunctionDef
+
+            def visit_Call(self, node: ast.Call) -> None:
+                held = tuple(self.held)
+                summary.call_held[id(node)] = held
+                cs = call_by_id.get(id(node))
+                if cs is not None and cs.kind == "spawn" \
+                        and cs.pool is not None:
+                    summary.pool_uses.append((cs.pool, node.lineno))
+                    if cs.name == "map" and not suppressed_here(
+                            node.lineno, ("PB104", "PB602")):
+                        summary.blocking.append(
+                            ("WorkPool.map submit-and-wait", node.lineno))
+                if isinstance(node.func, ast.Attribute):
+                    meth = node.func.attr
+                    if meth in ("wait",):
+                        ld = self._ld(node.func.value)
+                        if ld is not None and ld.is_condition:
+                            # a timed wait outside a loop is an
+                            # interruptible sleep, tolerant of spurious
+                            # wakeup — only untimed waits need the
+                            # predicate loop
+                            timed = bool(node.args) or any(
+                                kw.arg == "timeout" for kw in node.keywords)
+                            summary.waits.append(
+                                (ld.fp, node.lineno,
+                                 self.while_depth > 0 or timed))
+                            if not suppressed_here(node.lineno,
+                                                   ("PB104", "PB602")):
+                                summary.blocking.append(
+                                    (f"{ld.fp}.wait()", node.lineno))
+                    elif meth == "acquire":
+                        ld = self._ld(node.func.value)
+                        if ld is not None:
+                            summary.acquires.append(
+                                (ld.fp, node.lineno, held))
+                    elif meth == "result" or meth in _BLOCKING_IO:
+                        desc = ("Future.result()" if meth == "result"
+                                else f"{meth}()")
+                        if not suppressed_here(node.lineno,
+                                               ("PB104", "PB602")):
+                            summary.blocking.append((desc, node.lineno))
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in _BLOCKING_IO:
+                    if not suppressed_here(node.lineno, ("PB104", "PB602")):
+                        summary.blocking.append(
+                            (f"{node.func.id}()", node.lineno))
+                self.generic_visit(node)
+
+        w = W()
+        for stmt in fn.node.body:
+            w.visit(stmt)
+        return summary
+
+    # ---------------------------------------------------------- fixpoint
+    def _call_targets(self, cs: "callgraph.CallSite") -> Tuple[str, ...]:
+        """Sync-propagatable targets of a call site (widening capped)."""
+        if cs.kind != "call":
+            return ()
+        if cs.widened and len(cs.targets) > _WIDEN_FANOUT_CAP:
+            return ()
+        return cs.targets
+
+    def _order_targets(self, cs: "callgraph.CallSite") -> Tuple[str, ...]:
+        """Targets whose ACQUIRES order after the caller's held locks.
+        Sync calls, plus POOL spawns: ``WorkPool.map``/``submit`` run the
+        task inline on the caller's thread when the pool has one worker,
+        one item, or is re-entered from a worker — so a pool task's locks
+        really can nest inside the submitter's (the runtime witness sees
+        those edges; the static graph must over-approximate them).
+        ``Thread(target=)`` never runs inline and stays excluded."""
+        if cs.kind == "spawn":
+            if cs.pool is None:
+                return ()
+            # the submitter also runs WorkPool.map/submit's own body
+            # (bookkeeping under WorkPool._lock) on its thread
+            meth = f"utils.workpool.WorkPool.{cs.name}"
+            extra = (meth,) if meth in self.summaries else ()
+            return cs.targets + extra
+        return self._call_targets(cs)
+
+    def _fixpoint(self) -> None:
+        acq = {q: {fp for fp, _l, _h in s.acquires}
+               for q, s in self.summaries.items()}
+        blk: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        for q, s in self.summaries.items():
+            blk[q] = {desc: (s.fn.mod.path, line)
+                      for desc, line in s.blocking}
+        changed = True
+        while changed:
+            changed = False
+            for q, s in self.summaries.items():
+                for cs in s.fn.calls:
+                    # ordering (acq) flows through pool spawns too; the
+                    # blocking relation (blk → PB602) stays sync-only —
+                    # a task blocking on a pool thread does not stall
+                    # the submitter's lock holders
+                    for t in self._order_targets(cs):
+                        if t in acq and not acq[t] <= acq[q]:
+                            acq[q] |= acq[t]
+                            changed = True
+                    for t in self._call_targets(cs):
+                        for desc, wit in blk.get(t, {}).items():
+                            if desc not in blk[q]:
+                                blk[q][desc] = wit
+                                changed = True
+        self.acq = acq
+        self.blk = blk
+
+    # ------------------------------------------------------------- edges
+    def _add_edge(self, a: str, b: str, path: str, line: int,
+                  note: str) -> None:
+        if a != b:
+            self.edges.setdefault((a, b), (path, line, note))
+
+    def _build_edges(self) -> None:
+        for q, s in self.summaries.items():
+            path = s.fn.mod.path
+            for fp, line, held in s.acquires:
+                for h in held:
+                    self._add_edge(h, fp, path, line,
+                                   f"nested acquire in {q}")
+            for cs in s.fn.calls:
+                held = s.call_held.get(id(cs.node), ())
+                if not held:
+                    continue
+                for t in self._order_targets(cs):
+                    for fp in self.acq.get(t, ()):
+                        for h in held:
+                            self._add_edge(
+                                h, fp, path, cs.line,
+                                f"{q} → {t}")
+
+    # ---------------------------------------------------------- checkers
+    def _check_pb601(self) -> None:
+        seen: Set[Tuple[str, str]] = set()
+        for (a, b), (path, line, note) in sorted(self.edges.items()):
+            if (b, a) not in self.edges or (b, a) in seen:
+                continue
+            seen.add((a, b))
+            rpath, rline, rnote = self.edges[(b, a)]
+            self.findings.append(Finding(
+                path, line, "PB601",
+                f"lock-order inversion: {a} → {b} here ({note}) but "
+                f"{b} → {a} at {rpath}:{rline} ({rnote}) — potential "
+                f"ABBA deadlock; pick one global order"))
+
+    def _check_pb602(self) -> None:
+        for q, s in sorted(self.summaries.items()):
+            path = s.fn.mod.path
+            reported: Set[int] = set()
+            for cs in s.fn.calls:
+                held = s.call_held.get(id(cs.node), ())
+                if not held or cs.line in reported:
+                    continue
+                for t in self._call_targets(cs):
+                    hits = self.blk.get(t, {})
+                    if not hits:
+                        continue
+                    desc, (bpath, bline) = sorted(hits.items())[0]
+                    reported.add(cs.line)
+                    self.findings.append(Finding(
+                        path, cs.line, "PB602",
+                        f"{cs.name}() called while holding {held[-1]} "
+                        f"reaches blocking {desc} ({bpath}:{bline}) — "
+                        f"every other holder stalls behind it; move the "
+                        f"call outside the guarded region"))
+                    break
+
+    def _reachable(self, roots: Iterable[str]) -> Set[str]:
+        out: Set[str] = set()
+        stack = list(roots)
+        while stack:
+            q = stack.pop()
+            if q in out or q not in self.summaries:
+                continue
+            out.add(q)
+            for cs in self.summaries[q].fn.calls:
+                stack.extend(self._call_targets(cs))
+        return out
+
+    def _check_pb603(self) -> None:
+        pool_lock_fp = "utils.workpool.WorkPool._lock"
+        for q, s in sorted(self.summaries.items()):
+            for cs in s.fn.calls:
+                if cs.kind != "spawn" or cs.pool is None:
+                    continue
+                for t in cs.targets:
+                    for r in sorted(self._reachable([t])):
+                        rs = self.summaries.get(r)
+                        if rs is None or r == q:
+                            continue
+                        inner = [(k, l) for k, l in rs.pool_uses
+                                 if k == cs.pool or "?" in (k, cs.pool)]
+                        if inner:
+                            self.findings.append(Finding(
+                                s.fn.mod.path, cs.line, "PB603",
+                                f"task {t} submitted to the bounded "
+                                f"'{cs.pool}' pool re-enters a "
+                                f"'{inner[0][0]}' pool via {r} "
+                                f"({rs.fn.mod.path}:{inner[0][1]}) — "
+                                f"submit-and-wait from inside the pool "
+                                f"can starve the fixed worker set"))
+                            break
+                        if pool_lock_fp in {fp for fp, _l, _h
+                                            in rs.acquires}:
+                            self.findings.append(Finding(
+                                s.fn.mod.path, cs.line, "PB603",
+                                f"task {t} submitted to the bounded "
+                                f"'{cs.pool}' pool takes the pool's own "
+                                f"lock via {r} — deadlocks if the pool "
+                                f"holds it while dispatching"))
+                            break
+
+    def _check_pb604(self) -> None:
+        for q, s in sorted(self.summaries.items()):
+            for fp, line, in_while in s.waits:
+                if not in_while:
+                    self.findings.append(Finding(
+                        s.fn.mod.path, line, "PB604",
+                        f"{fp}.wait() outside a while-predicate loop — "
+                        f"wakeups are advisory; spurious wakeup or a "
+                        f"stolen predicate proceeds on stale state"))
+
+
+def analyze(modules: Sequence[Module]) -> LockAnalysis:
+    return LockAnalysis(callgraph.PackageGraph(modules))
+
+
+def analyze_paths(paths: Sequence[str]) -> LockAnalysis:
+    """Convenience for tests & the runtime cross-validation soak."""
+    from paddlebox_tpu.tools.pboxlint.core import iter_py_files
+    mods = []
+    for p in iter_py_files(paths):
+        with open(p, encoding="utf-8") as f:
+            mods.append(Module(p, f.read()))
+    return analyze(mods)
+
+
+def check(mod: Module, ctx: PackageContext) -> List[Finding]:
+    cache = getattr(ctx, "_lockgraph", None)
+    if cache is None:
+        cache = analyze(ctx.modules)
+        ctx._lockgraph = cache
+    return [f for f in cache.findings if f.path == mod.path]

@@ -54,12 +54,21 @@ _BLOCKING_IO = {"sendall", "sendto", "recv", "recv_into", "recvfrom",
                 "open"}
 
 
+_LOCKDEP_FACTORIES = {"lock", "rlock", "condition"}
+
+
 def _is_lock_ctor(node: ast.AST) -> bool:
     if not isinstance(node, ast.Call):
         return False
     name = dotted_name(node.func)
-    return name.rsplit(".", 1)[-1] in _LOCK_FACTORIES and (
-        "." not in name or name.startswith("threading."))
+    tail = name.rsplit(".", 1)[-1]
+    if tail in _LOCK_FACTORIES and (
+            "." not in name or name.startswith("threading.")):
+        return True
+    # utils/lockdep factories create (optionally instrumented) locks —
+    # they must count as lock ctors or converting a creation site would
+    # silently disable PB101/PB102/PB104 for that class
+    return tail in _LOCKDEP_FACTORIES and name.startswith("lockdep.")
 
 
 def _contains_lock_ctor(node: ast.AST) -> bool:

@@ -22,6 +22,7 @@ from typing import Optional
 import numpy as np
 import jax
 
+from paddlebox_tpu.utils import lockdep
 from paddlebox_tpu.utils.channel import Channel, ChannelClosed
 
 
@@ -31,7 +32,7 @@ class AsyncDenseTable:
                  eps: float = 1e-8, queue_capacity: int = 64):
         self._lr = learning_rate
         self._b1, self._b2, self._eps = beta1, beta2, eps
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("trainer.async_dense.AsyncDenseTable._lock")
         self._params = jax.tree.map(lambda a: np.array(a, np.float32),
                                     params)
         self._m = jax.tree.map(np.zeros_like, self._params)

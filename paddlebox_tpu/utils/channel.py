@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import collections
 import threading
+
+from paddlebox_tpu.utils import lockdep
 from typing import Any, Iterable, List, Optional
 
 
@@ -29,7 +31,7 @@ class Channel:
         self._cap = capacity if capacity > 0 else float("inf")
         self._q: collections.deque = collections.deque()
         self._closed = False
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("utils.channel.Channel._lock")
         self._not_full = threading.Condition(self._lock)
         self._not_empty = threading.Condition(self._lock)
 

@@ -95,6 +95,12 @@ def dump_state(reason: str = "", flight_n: int = _FLIGHT_N) -> Dict:
         bundle["workpool"] = workpool.pool_state()
     except Exception as e:          # never let the doctor itself wedge
         bundle["workpool"] = {"error": repr(e)}
+    try:
+        from paddlebox_tpu.utils import lockdep
+        if lockdep.enabled():
+            bundle["lockdep"] = lockdep.state()
+    except Exception as e:
+        bundle["lockdep"] = {"error": repr(e)}
     return bundle
 
 

@@ -37,7 +37,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional, Sequence, TypeVar
 
 from paddlebox_tpu import flags
-from paddlebox_tpu.utils import flight
+from paddlebox_tpu.utils import flight, lockdep
 from paddlebox_tpu.utils.monitor import (stat_add, stat_max, stat_observe,
                                          stat_set)
 
@@ -77,7 +77,7 @@ class WorkPool:
         self.kind = kind
         self.threads = max(1, int(threads))
         self._prefix = f"pbox-{kind}"
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("utils.workpool.WorkPool._lock")
         self._queued = 0        # submitted, not yet picked up
         self._active = 0        # running right now
         self._sat_hwm = 0       # deepest saturated queue flight-recorded

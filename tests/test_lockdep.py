@@ -1,0 +1,198 @@
+"""Runtime lockdep witness (utils/lockdep.py): passthrough-when-off,
+edge recording, ABBA cycle detection (bounded, no hang), doctor/flight
+integration, and the static/runtime cross-validation contract — every
+edge the witness observes in a real PS soak must exist in the pboxlint
+lockgraph's static over-approximation (same fingerprint namespace).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import flags
+from paddlebox_tpu.utils import doctor, flight, lockdep, workpool
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def lockdep_on():
+    prev = flags.get_flags("lockdep")
+    flags.set_flags({"lockdep": True})
+    lockdep.reset()
+    yield
+    flags.set_flags({"lockdep": prev})
+    lockdep.reset()
+
+
+def test_factories_passthrough_when_disabled():
+    """Flag off (the default): raw threading primitives, no wrapper —
+    the zero-cost contract production relies on."""
+    assert not lockdep.enabled()
+    lk = lockdep.lock("test.lockdep.raw")
+    assert type(lk) is type(threading.Lock())
+    rl = lockdep.rlock("test.lockdep.raw_r")
+    assert type(rl) is type(threading.RLock())
+    cv = lockdep.condition("test.lockdep.raw_cv")
+    assert isinstance(cv, threading.Condition)
+    with lk:
+        pass                            # still a working lock
+
+
+def test_nested_with_records_ordering_edge(lockdep_on):
+    a = lockdep.lock("test.lockdep.edge_A")
+    b = lockdep.lock("test.lockdep.edge_B")
+    with a:
+        with b:
+            pass
+    assert ("test.lockdep.edge_A", "test.lockdep.edge_B") in lockdep.edges()
+    # held-sets unwound cleanly
+    assert not any("edge_A" in str(v)
+                   for v in lockdep.held_by_thread().values())
+
+
+def test_condition_wait_pops_and_rerecords(lockdep_on):
+    """Condition(dep_rlock) duck-types acquire/release/_is_owned: a
+    wait() releases the instrumented lock (held-set pops) and reacquires
+    it on wake — no stale held entries, no phantom self-edges."""
+    lk = lockdep.rlock("test.lockdep.cv_lock")
+    cv = lockdep.condition("test.lockdep.cv_lock", lock=lk)
+    woke = []
+
+    def waiter():
+        with cv:
+            woke.append(cv.wait(timeout=5.0))
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    # let the waiter block, then wake it
+    for _ in range(1000):
+        with cv:
+            if cv.notify() is None and woke:
+                break
+        if woke:
+            break
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert woke and woke[0] in (True, False)
+    assert lockdep.held_by_thread() == {}
+    # no self-edge from the re-entrant reacquire
+    assert all(x != y for x, y in lockdep.edges())
+
+
+def test_abba_detected_bounded_with_flight_and_postmortem(
+        lockdep_on, tmp_path):
+    """The S4 integration: a deliberate two-thread ABBA under
+    FLAGS_lockdep produces a lock_cycle flight event and a postmortem
+    containing the cycle — WITHOUT hanging (timeout-bounded acquires;
+    edges are recorded at attempt time, before blocking)."""
+    a = lockdep.lock("test.lockdep.abba_A")
+    b = lockdep.lock("test.lockdep.abba_B")
+    gate = threading.Barrier(2, timeout=10)
+    got = {}
+
+    def one():
+        with a:
+            gate.wait()
+            got["one"] = b.acquire(timeout=1.0)
+            if got["one"]:
+                b.release()
+
+    def two():
+        with b:
+            gate.wait()
+            got["two"] = a.acquire(timeout=1.0)
+            if got["two"]:
+                a.release()
+
+    threads = [threading.Thread(target=one, daemon=True),
+               threading.Thread(target=two, daemon=True)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)              # the watchdog bound: no hang
+    assert not any(t.is_alive() for t in threads)
+
+    cycles = [c for c in lockdep.cycles()
+              if "test.lockdep.abba_A" in c["cycle"]]
+    assert cycles, lockdep.cycles()
+    assert "test.lockdep.abba_B" in cycles[0]["cycle"]
+
+    evs = [e for e in flight.events(kind="lock_cycle")
+           if "test.lockdep.abba_A" in e.get("path", "")]
+    assert evs, "no lock_cycle flight event"
+    assert "test.lockdep.abba_B" in evs[0]["path"]
+
+    path = doctor.write_postmortem(reason="abba-test",
+                                   directory=str(tmp_path))
+    with open(path, encoding="utf-8") as f:
+        bundle = json.load(f)
+    ld = bundle["lockdep"]
+    assert ld["enabled"] is True
+    assert any("test.lockdep.abba_A" in c["cycle"] for c in ld["cycles"])
+    # the postmortem also carries the acquisition-order graph
+    edge_pairs = {(e["from"], e["to"]) for e in ld["edges"]}
+    assert ("test.lockdep.abba_A", "test.lockdep.abba_B") in edge_pairs
+    assert ("test.lockdep.abba_B", "test.lockdep.abba_A") in edge_pairs
+
+
+def test_cycle_reported_once_and_clean_order_silent(lockdep_on):
+    a = lockdep.lock("test.lockdep.once_A")
+    b = lockdep.lock("test.lockdep.once_B")
+    for _ in range(5):                  # consistent a→b order: no cycle
+        with a:
+            with b:
+                pass
+    assert not [c for c in lockdep.cycles()
+                if "test.lockdep.once_A" in c["cycle"]]
+
+
+def test_cross_validation_runtime_edges_subset_of_static(lockdep_on):
+    """The tier-1 contract the two PB6xx halves share: drive a real PS
+    round-trip (delta-locked create path, table pool forced inline so
+    pool-task locks nest on the serving thread) and assert every
+    runtime-observed edge exists in the static lockgraph — same
+    class-fingerprint namespace, runtime ⊆ static over-approximation."""
+    from paddlebox_tpu.config import EmbeddingTableConfig
+    from paddlebox_tpu.ps.host_table import ShardedHostTable
+    from paddlebox_tpu.ps.service import PSClient, PSServer
+    from paddlebox_tpu.tools.pboxlint import lockgraph
+
+    prev_threads = flags.get_flags("ps_table_threads")
+    flags.set_flags({"ps_table_threads": 1})
+    lockdep.reset()
+    try:
+        table = ShardedHostTable(
+            EmbeddingTableConfig(embedding_dim=3, shard_num=4))
+        srv = PSServer(table)
+        try:
+            client = PSClient(srv.addr)
+            keys = np.arange(1, 40, dtype=np.uint64)
+            rows = client.pull_sparse(keys, create=True)
+            rows["show"][:] += 1
+            client.push_sparse(keys, rows)
+            client.end_day()
+        finally:
+            srv.shutdown()
+        runtime = [e for e in lockdep.edges()
+                   if not e[0].startswith("test.")
+                   and not e[1].startswith("test.")]
+        # the inline fan-out must have nested pool-task locks inside the
+        # verb-serialization lock — the soak is not allowed to be vacuous
+        assert ("ps.service.PSServer._delta_locks",
+                "ps.host_table._Shard.lock") in runtime
+        static = set(
+            lockgraph.analyze_paths(
+                [os.path.join(REPO, "paddlebox_tpu")]).edges)
+        missing = [e for e in runtime if e not in static]
+        assert not missing, (
+            f"runtime edges unexplained by the static graph: {missing}")
+        # and no cycles in the production lock order
+        assert not [c for c in lockdep.cycles()
+                    if not c["cycle"][0].startswith("test.")]
+    finally:
+        flags.set_flags({"ps_table_threads": prev_threads})
+        workpool.table_pool()           # resize the singleton back

@@ -1,0 +1,499 @@
+"""PB6xx lockgraph: PB601-604 positive/negative snippets plus the
+callgraph edge cases the interprocedural analysis rests on (decorated
+defs, nested closures, inheritance resolution, WorkPool submit targets,
+and the widening-never-drops-held-set rule).
+
+Snippets run through the same ``lockgraph.analyze`` used by the tier-1
+gate; multi-module cases pass several (path, source) pairs so the call
+graph crosses file boundaries like the real package does.
+"""
+
+import textwrap
+
+from paddlebox_tpu.tools.pboxlint import callgraph, lockgraph
+from paddlebox_tpu.tools.pboxlint.core import Module
+
+
+def analysis(*mods):
+    """mods: (path, source) pairs → LockAnalysis."""
+    return lockgraph.analyze(
+        [Module(p, textwrap.dedent(s)) for p, s in mods])
+
+
+def codes(*mods):
+    return sorted(f.code for f in analysis(*mods).findings)
+
+
+def graph(*mods):
+    return callgraph.PackageGraph(
+        [Module(p, textwrap.dedent(s)) for p, s in mods])
+
+
+# -- PB601 lock-order inversion ----------------------------------------------
+
+def test_pb601_direct_abba():
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+    """
+    a = analysis(("m.py", src))
+    pb601 = [f for f in a.findings if f.code == "PB601"]
+    assert len(pb601) == 1                   # one finding per unordered pair
+    assert "m.S._a" in pb601[0].message and "m.S._b" in pb601[0].message
+
+
+def test_pb601_interprocedural_abba():
+    # one() nests a→b lexically; two() holds b while CALLING a function
+    # that takes a — the inversion only exists through the call graph
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def takes_a(self):
+            with self._a:
+                return 1
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                return self.takes_a()
+    """
+    assert "PB601" in codes(("m.py", src))
+
+
+def test_pb601_negative_consistent_order():
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._a:
+                with self._b:
+                    pass
+    """
+    assert codes(("m.py", src)) == []
+
+
+def test_pb601_thread_spawn_does_not_carry_held_set():
+    # a Thread target runs on ANOTHER thread, never inline: holding a
+    # while starting a b-taker is not an a→b ordering edge, so the
+    # reverse b→a nesting elsewhere is not an inversion
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def takes_b(self):
+            with self._b:
+                return 1
+
+        def one(self):
+            with self._a:
+                t = threading.Thread(target=self.takes_b, daemon=True)
+                t.start()
+                t.join()
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+    """
+    assert "PB601" not in codes(("m.py", src))
+
+
+def test_pb601_pool_spawn_orders_like_inline_call():
+    # WorkPool runs tasks inline on the submitting thread (one worker /
+    # one item / re-entrant), so pool hand-offs DO order: a while
+    # submitting a b-taker + b→a nesting elsewhere is an inversion
+    src = """
+    import threading
+    from paddlebox_tpu.utils.workpool import table_pool
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def takes_b(self, x):
+            with self._b:
+                return x
+
+        def one(self, xs):
+            with self._a:
+                return table_pool().map(self.takes_b, xs)
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+    """
+    assert "PB601" in codes(("m.py", src))
+
+
+# -- PB602 transitive blocking under a lock ----------------------------------
+
+def test_pb602_transitive_blocking_call():
+    src = """
+    import socket
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._sock = socket.socket()
+
+        def _send(self):
+            self._sock.sendall(b"x")
+
+        def flush(self):
+            with self._lock:
+                self._send()
+    """
+    a = analysis(("m.py", src))
+    pb602 = [f for f in a.findings if f.code == "PB602"]
+    assert len(pb602) == 1
+    assert "m.C._lock" in pb602[0].message
+    assert "sendall" in pb602[0].message
+
+
+def test_pb602_crosses_module_boundary():
+    util = """
+    def slow_read(path):
+        with open(path) as f:
+            return f.read()
+    """
+    user = """
+    import threading
+    from pkg.util import slow_read
+
+    _LOCK = threading.Lock()
+
+    def cached(path):
+        with _LOCK:
+            return slow_read(path)
+    """
+    got = codes(("paddlebox_tpu/pkg/util.py", util),
+                ("paddlebox_tpu/pkg/user.py", user))
+    assert "PB602" in got
+
+
+def test_pb602_negative_blocking_outside_lock():
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def _load(self, path):
+            with open(path) as f:
+                return f.read()
+
+        def refresh(self, path):
+            data = self._load(path)
+            with self._lock:
+                self.data = data
+    """
+    assert "PB602" not in codes(("m.py", src))
+
+
+def test_pb602_suppression_at_blocking_site_stops_propagation():
+    src = """
+    import threading
+
+    class Log:
+        def __init__(self, path):
+            self.path = path
+            self._lock = threading.Lock()
+
+        def _write(self, rec):
+            # pboxlint: disable-next=PB104 -- the file IS the locked thing
+            with open(self.path, "ab") as fh:
+                fh.write(rec)
+
+        def append(self, rec):
+            with self._lock:
+                self._write(rec)
+    """
+    assert "PB602" not in codes(("m.py", src))
+
+
+# -- PB603 pool re-entrancy ---------------------------------------------------
+
+def test_pb603_pooled_task_reenters_same_pool():
+    src = """
+    from paddlebox_tpu.utils.workpool import table_pool
+
+    def inner(x):
+        return x
+
+    def task(xs):
+        return table_pool().map(inner, xs)
+
+    def outer(xs):
+        return table_pool().submit(task, xs).result()
+    """
+    a = analysis(("m.py", src))
+    pb603 = [f for f in a.findings if f.code == "PB603"]
+    assert pb603 and "table" in pb603[0].message
+
+
+def test_pb603_negative_different_pool_kind():
+    src = """
+    from paddlebox_tpu.utils.workpool import pack_pool, table_pool
+
+    def inner(x):
+        return x
+
+    def task(xs):
+        return pack_pool().map(inner, xs)
+
+    def outer(xs):
+        return table_pool().submit(task, xs).result()
+    """
+    assert "PB603" not in codes(("m.py", src))
+
+
+# -- PB604 wait outside predicate loop ---------------------------------------
+
+def test_pb604_wait_outside_while():
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._cv = threading.Condition()
+
+        def get(self):
+            with self._cv:
+                self._cv.wait()
+                return 1
+    """
+    assert "PB604" in codes(("m.py", src))
+
+
+def test_pb604_negative_wait_in_while_and_timed_wait():
+    # the predicate loop is the sanctioned shape; a TIMED wait outside a
+    # loop is an interruptible sleep, tolerant of spurious wakeup
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self._items = []
+
+        def get(self):
+            with self._cv:
+                while not self._items:
+                    self._cv.wait()
+                return self._items.pop()
+
+        def nap(self):
+            with self._cv:
+                self._cv.wait(0.5)
+    """
+    assert "PB604" not in codes(("m.py", src))
+
+
+# -- callgraph edge cases (S3) ------------------------------------------------
+
+def test_callgraph_decorated_def_still_indexed():
+    src = """
+    import functools
+    import threading
+
+    _LOCK = threading.Lock()
+
+    def deco(fn):
+        return fn
+
+    @deco
+    def guarded(path):
+        with _LOCK:
+            with open(path) as f:
+                return f.read()
+
+    @functools.lru_cache(None)
+    def outer(path):
+        with _LOCK:
+            return guarded(path)
+    """
+    g = graph(("m.py", src))
+    assert "m.guarded" in g.functions
+    assert "m.outer" in g.functions
+    # resolution through the decorated name still lands on the def
+    outer_calls = {t for cs in g.functions["m.outer"].calls
+                   for t in cs.targets}
+    assert "m.guarded" in outer_calls
+
+
+def test_callgraph_nested_closure_qnames_and_ownership():
+    # the closure gets its own qname chain; its body's calls belong to
+    # IT, not to the enclosing function
+    src = """
+    class Shard:
+        def lookup(self):
+            return {}
+
+    def bulk(shards):
+        def pull_shard(s):
+            return s.lookup()
+
+        return [pull_shard(s) for s in shards]
+    """
+    g = graph(("m.py", src))
+    assert "m.bulk.pull_shard" in g.functions
+    bulk_names = [cs.name for cs in g.functions["m.bulk"].calls]
+    assert "lookup" not in bulk_names
+    closure = g.functions["m.bulk.pull_shard"]
+    lookup_calls = [cs for cs in closure.calls if cs.name == "lookup"]
+    assert lookup_calls and "m.Shard.lookup" in lookup_calls[0].targets
+
+
+def test_callgraph_inheritance_method_resolution():
+    base = """
+    class Base:
+        def save(self):
+            return self._flush()
+
+        def _flush(self):
+            return 0
+    """
+    sub = """
+    from pkg.base import Base
+
+    class Sub(Base):
+        def _flush(self):
+            return 1
+
+    def run():
+        s = Sub()
+        return s.save()
+    """
+    g = graph(("paddlebox_tpu/pkg/base.py", base),
+              ("paddlebox_tpu/pkg/sub.py", sub))
+    assert g.classes["pkg.sub.Sub"].bases == ["pkg.base.Base"]
+    run_targets = {t for cs in g.functions["pkg.sub.run"].calls
+                   for t in cs.targets}
+    # save resolves up the hierarchy into Base
+    assert "pkg.base.Base.save" in run_targets
+    # the self._flush() inside Base.save sees the Sub override too
+    save_targets = {t for cs in g.functions["pkg.base.Base.save"].calls
+                    for t in cs.targets}
+    assert "pkg.sub.Sub._flush" in save_targets
+    assert "pkg.base.Base._flush" in save_targets
+
+
+def test_callgraph_workpool_submit_targets_are_spawn_edges():
+    src = """
+    from paddlebox_tpu.utils.workpool import table_pool
+
+    def work(x):
+        return x + 1
+
+    def fan(xs):
+        pool = table_pool()
+        futs = [pool.submit(work, x) for x in xs]
+        pool.map(work, xs)
+        return futs
+    """
+    g = graph(("m.py", src))
+    spawns = [cs for cs in g.functions["m.fan"].calls if cs.kind == "spawn"]
+    assert len(spawns) == 2                  # submit + map
+    for cs in spawns:
+        assert cs.targets == ("m.work",)
+        assert cs.pool == "table"
+
+
+def test_callgraph_dynamic_call_widens_not_drops():
+    """The S3 soundness rule: an unresolvable receiver must WIDEN (CHA
+    over same-named methods, held-set preserved) — never silently drop
+    the call.  Here `t` is untyped, so t.flush() widens to every
+    package .flush, and the held lock still reaches the blocking body →
+    PB602 must fire."""
+    impl = """
+    class Table:
+        def spill(self):
+            with open("/tmp/x", "wb") as f:
+                f.write(b"")
+    """
+    user = """
+    import threading
+
+    _LOCK = threading.Lock()
+
+    def persist(t):
+        with _LOCK:
+            t.spill()
+    """
+    g = graph(("paddlebox_tpu/pkg/impl.py", impl),
+              ("paddlebox_tpu/pkg/user.py", user))
+    persist_calls = [cs for cs in g.functions["pkg.user.persist"].calls
+                     if cs.name == "spill"]
+    assert persist_calls and persist_calls[0].widened
+    assert "pkg.impl.Table.spill" in persist_calls[0].targets
+    got = codes(("paddlebox_tpu/pkg/impl.py", impl),
+                ("paddlebox_tpu/pkg/user.py", user))
+    assert "PB602" in got
+
+
+def test_lockdep_factory_literal_is_the_fingerprint():
+    # a lockdep-factory lock uses the literal name argument as its
+    # fingerprint — the shared namespace the runtime witness reports in
+    src = """
+    from paddlebox_tpu.utils import lockdep
+
+    class S:
+        def __init__(self):
+            self._a = lockdep.lock("pkg.mod.S._a")
+            self._b = lockdep.lock("pkg.mod.S._b")
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+    """
+    a = analysis(("m.py", src))
+    assert ("pkg.mod.S._a", "pkg.mod.S._b") in a.edges
+    assert ("pkg.mod.S._b", "pkg.mod.S._a") in a.edges
+    assert [f.code for f in a.findings] == ["PB601"]

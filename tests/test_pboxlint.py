@@ -7,7 +7,9 @@ row-size estimate mutated outside self._lock) — the canary PB102 must keep
 catching even though the tree itself is fixed.
 """
 
+import json
 import os
+import re
 import subprocess
 import sys
 import textwrap
@@ -248,12 +250,14 @@ def test_pb201_unregistered_flag_name():
 
 def test_pb202_default_must_roundtrip_coerce():
     src = """
-    from paddlebox_tpu.flags import define_flag
+    from paddlebox_tpu.flags import define_flag, get_flags
 
     define_flag("ok_int", 20, "fine")
     define_flag("ok_bool", True, "fine")
     define_flag("ok_str", "auto", "fine")
     define_flag("bad_list", [1, 2], "env override cannot parse a list")
+    vals = [get_flags(n) for n in
+            ("ok_int", "ok_bool", "ok_str", "bad_list")]
     """
     assert codes(src) == ["PB202"]
 
@@ -270,6 +274,41 @@ def test_pb203_raw_flags_environ_read():
     assert sorted(codes(src)) == ["PB203", "PB203", "PB203"]
     # the registry itself is allowed to read its own env overrides
     assert codes(src, path="flags.py") == []
+
+
+def test_pb205_dead_flag_defined_but_never_read():
+    src = """
+    from paddlebox_tpu.flags import define_flag, get_flags
+
+    define_flag("live_flag", 1, "read below")
+    define_flag("dead_flag", 0, "never read anywhere")
+    x = get_flags("live_flag")
+    """
+    assert codes(src) == ["PB205"]
+
+
+def test_pb205_set_flags_literal_counts_as_use():
+    src = """
+    from paddlebox_tpu.flags import define_flag, set_flags
+
+    define_flag("tuned_flag", 1, "set by the launcher")
+    set_flags({"tuned_flag": 2})
+    """
+    assert codes(src) == []
+
+
+def test_pb205_dynamic_reads_disarm_the_rule():
+    # a get_flags(variable) anywhere means reads are out of static
+    # reach — the rule must go quiet rather than false-positive
+    src = """
+    from paddlebox_tpu.flags import define_flag, get_flags
+
+    define_flag("maybe_dead", 1, "read dynamically below")
+
+    def read(name):
+        return get_flags(name)
+    """
+    assert codes(src) == []
 
 
 def test_pb206_flight_kind_unbounded_fstring():
@@ -937,3 +976,102 @@ def test_cli_whole_package_exits_zero():
          "paddlebox_tpu/"],
         capture_output=True, text=True, cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_pb601_wired_into_default_checker_set():
+    """PB6xx rides the same gate as every other family: plain
+    lint_source over an ABBA snippet must surface PB601."""
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+    """
+    assert "PB601" in codes(src)
+
+
+def test_cli_json_and_baseline_diff(tmp_path):
+    """--format=json emits findings/counts; --baseline exits 0 on an
+    unchanged tree and 1 only when a NEW per-file/per-code bucket
+    appears (line/message churn must not fail the diff)."""
+    snip = tmp_path / "prefix_service.py"
+    snip.write_text(_PREFIX_SERVICE_SNIPPET)
+    base = tmp_path / "base.json"
+    cmd = [sys.executable, "-m", "paddlebox_tpu.tools.pboxlint"]
+    proc = subprocess.run(
+        cmd + ["--format=json", "--write-baseline", str(base), str(snip)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert {f["code"] for f in out["findings"]} == {"PB102"}
+    assert out["counts"] == {f"{snip}:PB102": len(out["findings"])}
+
+    # same tree against its own baseline: no new buckets, exit 0
+    proc = subprocess.run(
+        cmd + ["--baseline", str(base), str(snip)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # a genuinely new finding bucket fails the diff
+    leak = tmp_path / "leak.py"
+    leak.write_text("import threading\n\n\n"
+                    "def bad():\n"
+                    "    t = threading.Thread(target=work)\n"
+                    "    t.start()\n")
+    proc = subprocess.run(
+        cmd + ["--baseline", str(base), str(snip), str(leak)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "NEW vs baseline" in proc.stdout
+    assert "PB401" in proc.stdout
+
+
+def test_launcher_exports_and_readme_flags_are_registered():
+    """S2 cross-check: every FLAGS_<name> env export in launch.py and
+    every README flag-table row must name a flag actually registered via
+    define_flag somewhere in the package — renaming or removing a flag
+    must not leave a stale launcher export or doc row behind."""
+    from paddlebox_tpu.tools.pboxlint.core import (Module, PackageContext,
+                                                   iter_py_files)
+    mods = []
+    for path in iter_py_files([os.path.join(REPO, "paddlebox_tpu")]):
+        with open(path, encoding="utf-8") as f:
+            mods.append(Module(path, f.read()))
+    defined = PackageContext(mods).defined_flags
+
+    launch_src = open(
+        os.path.join(REPO, "paddlebox_tpu", "launch.py"),
+        encoding="utf-8").read()
+    exported = set(re.findall(r'env(?:iron)?\[\s*"FLAGS_(\w+)"', launch_src))
+    assert exported, "no FLAGS_ env exports found in launch.py"
+    assert exported <= defined, \
+        f"launch.py exports unregistered flags: {sorted(exported - defined)}"
+
+    readme = open(os.path.join(REPO, "README.md"), encoding="utf-8").read()
+    rows = set()
+    in_table = False
+    for line in readme.splitlines():
+        if line.replace(" ", "").startswith("|flag|"):
+            in_table = True
+            continue
+        if in_table:
+            m = re.match(r"\|\s*`(\w+)`\s*\|", line)
+            if m:
+                rows.add(m.group(1))
+            elif not line.startswith("|"):
+                in_table = False
+    assert rows, "no README flag-table rows parsed"
+    assert rows <= defined, \
+        f"README documents unregistered flags: {sorted(rows - defined)}"
