@@ -54,7 +54,9 @@ BENCH_BACKEND_ATTEMPT_S (per-attempt backend-init window, default 150),
 BENCH_NO_SUPERVISE=1 (single-process debug mode),
 BENCH_COMPARE_THRESHOLD (default regression threshold for --compare),
 BENCH_CACHE=0 (skip the device-cache on/off compare),
-BENCH_CACHE_PASSES/_KEYS/_DRAWS/_ROWS (cache-compare geometry).
+BENCH_CACHE_PASSES/_KEYS/_DRAWS/_ROWS (cache-compare geometry),
+BENCH_TIMELINE_S (telemetry-timeline sampler cadence, default 1.0;
+0 disables — the run's `timeline` summary then stays empty).
 """
 
 import json
@@ -186,6 +188,79 @@ def _obs_snapshot():
             obs.update(stat_snapshot(prefix))
         return {k: round(v, 6) if isinstance(v, float) else v
                 for k, v in sorted(obs.items())}
+    except Exception:  # diagnostics must never sink the result line
+        return {}
+
+
+def _bench_slo_rules():
+    """The production rule set minus throughput_stall: the bench's
+    step-profile and cache-compare phases run for minutes without a
+    single device step BY DESIGN, so the stall rule would breach on
+    every healthy run and poison the --compare gate."""
+    from paddlebox_tpu.utils import timeline
+    return [r for r in timeline.default_rules()
+            if r.name != "throughput_stall"]
+
+
+def _start_timeline(restart=False):
+    """Run the telemetry timeline sampler (utils/timeline.py): 1 s
+    cadence by default, BENCH_TIMELINE_S=0 disables.  Its summary lands
+    in the result line and --compare gates on new SLO breaches.
+    restart=True tears the ring down first — each bench geometry is a
+    fresh job, and the previous config's samples must not sit inside
+    the new watchdog's evaluation window."""
+    try:
+        interval = float(os.environ.get("BENCH_TIMELINE_S", 1.0))
+        if interval <= 0:
+            return
+        from paddlebox_tpu.utils import timeline
+        if restart:
+            timeline.stop()
+        timeline.start(interval_s=interval, cap=4096,
+                       rules=_bench_slo_rules())
+    except Exception:  # diagnostics must never sink the run
+        pass
+
+
+def _quality_observe(metrics):
+    """Feed one pass result to the training-quality monitors so the
+    timeline carries an AUC trajectory (fleet.train_passes does this in
+    production; the bench drives the trainer directly)."""
+    try:
+        from paddlebox_tpu.metrics import quality
+        quality.observe_pass(metrics)
+    except Exception:
+        pass
+
+
+def _timeline_summary():
+    """The timeline's view of the run for the BENCH JSON: throughput-
+    over-time stability (per-interval step-dispatch rates), the AUC
+    trajectory, and the SLO breach count."""
+    try:
+        from paddlebox_tpu.metrics import quality
+        from paddlebox_tpu.utils import flight, timeline
+        s = timeline.sampler()
+        if s is None:
+            return {}
+        rates = [r for _, r in
+                 s.ring.series("trainer.step_dispatch_s.count")["rates"]
+                 if r > 0]
+        thr = {}
+        if rates:
+            mean = sum(rates) / len(rates)
+            var = sum((r - mean) ** 2 for r in rates) / len(rates)
+            thr = {"steps_per_s_mean": round(mean, 3),
+                   "steps_per_s_cv":
+                       round(var ** 0.5 / mean, 4) if mean else 0.0,
+                   "active_intervals": len(rates)}
+        breaches = flight.events(kind="slo_breach")
+        return {"samples": len(s.ring), "interval_s": s.interval_s,
+                "throughput": thr,
+                "auc_trajectory": [round(a, 4) for a in quality.aucs()],
+                "slo_breaches": len(breaches),
+                "breached_rules": sorted({b.get("rule") for b in breaches}),
+                "slo_states": s.watchdog.states()}
     except Exception:  # diagnostics must never sink the result line
         return {}
 
@@ -353,7 +428,8 @@ def _pass_cycle(tag, dataset, engine, trainer, n_passes):
                 engine.end_feed_pass()
                 engine.begin_pass()
                 feed = trainer.build_pass_feed(dataset)
-                trainer.train_pass(feed, progress=heartbeat(p))
+                _quality_observe(
+                    trainer.train_pass(feed, progress=heartbeat(p)))
                 engine.end_pass()
         else:
             pf = PassPrefetcher(engine, trainer)
@@ -364,7 +440,8 @@ def _pass_cycle(tag, dataset, engine, trainer, n_passes):
                     set_phase(f"{tag}:pass-cycle:pipelined"
                               f"[pass {p + 1}/{n_passes}]", 900)
                     feed = pf.next_pass()
-                    trainer.train_pass(feed, progress=heartbeat(p))
+                    _quality_observe(
+                        trainer.train_pass(feed, progress=heartbeat(p)))
                     pf.end_pass()
             finally:
                 pf.close()
@@ -537,6 +614,15 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     N_SLOTS, DENSE_DIM, MF_DIM, CAP = 26, 13, 8, 3
     STEPS_WARM = 5
 
+    try:      # each geometry is a fresh model: restart the AUC trajectory
+        from paddlebox_tpu.metrics import quality
+        quality.reset()
+    except Exception:
+        pass
+    # ... and a fresh timeline ring: the smoke config's gauges must not
+    # read as drops/collapses inside this config's watchdog window
+    _start_timeline(restart=True)
+
     set_phase(f"{tag}:data-build", 240)
     rng = np.random.default_rng(0)
     dataset = SlotDataset(DataFeedConfig(slots=tuple(
@@ -663,6 +749,7 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     else:
         stats = trainer.train_pass(feed, progress=heartbeat)
     dt = time.perf_counter() - t0
+    _quality_observe(stats)
     e2e_eps = n_examples / dt
     record(**{("e2e" if tag == "full" else f"{tag}_e2e"): round(e2e_eps, 1)})
     trace(f"{tag}: e2e={e2e_eps:,.0f} ex/s over {dt:.1f}s")
@@ -788,6 +875,7 @@ def run() -> None:
     # later wedges, the recorded round still proves the chip was reachable
     record(backend=backend, n_devices=len(devices))
     emit(0.0, stage="backend-up", backend=backend, n_devices=len(devices))
+    _start_timeline()
     fail = os.environ.get("BENCH_TEST_FAIL_AFTER_INIT")
     if fail:    # harness-test hook: deterministic post-backend failure
         raise RuntimeError(fail)
@@ -814,7 +902,8 @@ def run() -> None:
              stage="smoke", device_step=round(smoke["device_step"], 1),
              backend=backend, batches=smoke["batches"],
              compile_s=smoke["compile_s"],
-             **({"obs_stats": _obs_snapshot()} if smoke_only else {}))
+             **({"obs_stats": _obs_snapshot(),
+                 "timeline": _timeline_summary()} if smoke_only else {}))
         if smoke_only:
             return
         if os.environ.get("BENCH_TEST_DIE_AFTER_SMOKE") == "1":
@@ -836,7 +925,7 @@ def run() -> None:
          pass_cycle=full["pass_cycle"], recovery=full["recovery"],
          cache=full["cache"],
          feed_intervals=full["feed_intervals"], timers=full["timers"],
-         obs_stats=_obs_snapshot())
+         timeline=_timeline_summary(), obs_stats=_obs_snapshot())
 
 
 def child_main() -> None:
@@ -851,8 +940,8 @@ def child_main() -> None:
     except Exception as e:
         trace(f"FAILED in phase {_STATE['phase']}: {type(e).__name__}: {e}")
         emit(_best(), final=True, error=f"{type(e).__name__}: {e}",
-             last_phase=_STATE["phase"],
-             partial=dict(_STATE["partial"]), obs_stats=_obs_snapshot())
+             last_phase=_STATE["phase"], partial=dict(_STATE["partial"]),
+             timeline=_timeline_summary(), obs_stats=_obs_snapshot())
         # exit 0: the driver must always find a parseable JSON line
     finally:
         with _LOCK:
@@ -1112,8 +1201,10 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
     """Diff two BENCH result files; 0 = within threshold, 1 = regression.
 
     Regressions: headline value drops by more than the threshold fraction,
-    or feed_gap_ratio grows by more than it.  obs_stats movers beyond the
-    threshold are reported (informational — counters legitimately move)."""
+    feed_gap_ratio grows by more than it, or the run picked up NEW SLO
+    breaches (timeline.slo_breaches above the old run's count).  obs_stats
+    movers beyond the threshold are reported (informational — counters
+    legitimately move)."""
     if threshold is None:
         threshold = float(os.environ.get("BENCH_COMPARE_THRESHOLD", 0.05))
     old, new = _load_result(old_path), _load_result(new_path)
@@ -1137,7 +1228,17 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
         gfrac = (gn - go) / go
         out["feed_gap_ratio"] = {"old": go, "new": gn,
                                  "delta_frac": round(gfrac, 4)}
-        if gfrac > threshold:
+        # the ratio's denominator is device-busy seconds: when both runs
+        # saw an essentially idle device (CPU basis: ~4 ms busy across a
+        # ~50 s pass) a 1 ms timing wobble swings the ratio by double
+        # digits, so the gate only arms on a non-degenerate measurement
+        dbo = num(old, "device_busy_frac")
+        dbn = num(new, "device_busy_frac")
+        degenerate = (dbo is not None and dbn is not None
+                      and max(dbo, dbn) < 0.01)
+        if degenerate:
+            out["feed_gap_ratio"]["degenerate"] = True
+        elif gfrac > threshold:
             regressions.append(
                 f"feed_gap_ratio {go:.2f} -> {gn:.2f} ({gfrac:+.1%})")
     so = num(old.get("pass_cycle") or {}, "speedup")
@@ -1176,6 +1277,17 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
         if mfrac > threshold:
             regressions.append(
                 f"recovery.mttr_s {mo:.3f} -> {mn:.3f} ({mfrac:+.1%})")
+    bo = num(old.get("timeline") or {}, "slo_breaches") or 0.0
+    bn = num(new.get("timeline") or {}, "slo_breaches")
+    if bn is not None:                  # new SLO breaches = regression
+        out["slo_breaches"] = {
+            "old": int(bo), "new": int(bn),
+            "new_rules": (new.get("timeline") or {}).get("breached_rules",
+                                                         [])}
+        if bn > bo:
+            regressions.append(
+                f"slo_breaches {int(bo)} -> {int(bn)} "
+                f"({(new.get('timeline') or {}).get('breached_rules', [])})")
     oo = old.get("obs_stats") or {}
     on = new.get("obs_stats") or {}
     movers = []

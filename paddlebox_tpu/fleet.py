@@ -224,6 +224,7 @@ def train_passes(trainer: SparseTrainer, dataset: BoxPSDataset,
     so indices still line up with ``passes``."""
     from paddlebox_tpu import flags as _flags
     from paddlebox_tpu.data.prefetch import PassPrefetcher
+    from paddlebox_tpu.metrics import quality as _quality
     from paddlebox_tpu.ps import faults as _faults
     from paddlebox_tpu.utils.backoff import Backoff as _Backoff
     from paddlebox_tpu.utils.monitor import stat_add as _stat_add
@@ -301,6 +302,8 @@ def train_passes(trainer: SparseTrainer, dataset: BoxPSDataset,
             m = trainer.train_pass(feed)
             end_with_replay(dataset.end_pass)
             metrics.append(m)
+            _quality.observe_pass(m, pass_id=engine.pass_id,
+                                  day=engine.day_id)
             save_cursor(i)
 
     def run_prefetch(todo) -> None:
@@ -324,6 +327,8 @@ def train_passes(trainer: SparseTrainer, dataset: BoxPSDataset,
                 # the blocks the worker already loaded for the NEXT pass
                 end_with_replay(pf.end_pass)
                 metrics.append(m)
+                _quality.observe_pass(m, pass_id=engine.pass_id,
+                                      day=engine.day_id)
                 save_cursor(i)
         except BaseException:
             # failure path only: drop the pipeline AND the engine's

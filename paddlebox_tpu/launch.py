@@ -54,6 +54,95 @@ def init_distributed(coordinator: Optional[str] = None,
     return rank
 
 
+class ClusterScraper:
+    """Supervisor-side cluster aggregation: a periodic thread pulling
+    every worker's ``/statz?raw=1``, folding the live scrapes through
+    the bucket-wise ``obs_server.merge_snapshots`` into a JOB-LEVEL
+    timeline (utils/timeline.TimelineRing), served at ``/clusterz`` —
+    the horizontal half of the telemetry timeline.
+
+    Tolerant of dead/restarting workers by construction: a failed
+    scrape just drops that worker from the interval's fold (and marks
+    it dead in the ``workers`` map) — the merged series carries on with
+    whoever answers.  ``stop()`` joins the thread (PB405)."""
+
+    def __init__(self, ports: List[int], interval_s: float = 5.0,
+                 cap: int = 512, host: str = "127.0.0.1",
+                 prefix: str = ""):
+        from paddlebox_tpu.utils import obs_server, timeline
+        self._obs = obs_server
+        self.ports = list(ports)
+        self.interval_s = float(interval_s)
+        self.host = host
+        # narrow the per-interval pull to one dotted subtree (the
+        # /statz?prefix= filter) — "" scrapes everything
+        self.prefix = prefix
+        self.ring = timeline.TimelineRing(cap)
+        self._alive: Dict[int, bool] = {p: False for p in self.ports}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def scrape_once(self) -> int:
+        """One scrape+merge round; returns how many workers answered
+        (0 appends nothing — an all-dead interval is a gap, not a zero
+        sample)."""
+        path = "/statz?raw=1"
+        if self.prefix:
+            path += f"&prefix={self.prefix}"
+        snaps = []
+        for p in self.ports:
+            snap = self._obs.scrape(p, path=path, host=self.host)
+            with self._lock:
+                self._alive[p] = snap is not None
+            if snap:
+                snaps.append(snap)
+        if snaps:
+            merged = self._obs.merge_snapshots(snaps)
+            # pboxlint: disable-next=PB102 -- TimelineRing locks internally; single scrape-thread writer
+            self.ring.append(merged)
+        return len(snaps)
+
+    def start(self) -> "ClusterScraper":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="pbox-clusterscrape", daemon=True)
+            self._thread.start()
+        self._obs.set_clusterz_provider(self.render)
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrape_once()
+            except Exception:  # noqa: BLE001 — scraping must never die
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._obs.set_clusterz_provider(None)
+
+    def render(self, name: Optional[str] = None,
+               n: Optional[int] = None) -> Dict:
+        """The /clusterz payload: index + per-worker liveness, or one
+        merged metric's series via ``?name=``."""
+        if name:
+            out = self.ring.series(name, n=n)
+            out["enabled"] = True
+            return out
+        with self._lock:
+            workers = {str(p): alive for p, alive in self._alive.items()}
+        latest = self.ring.samples(1)
+        return {"enabled": True, "interval_s": self.interval_s,
+                "len": len(self.ring), "workers": workers,
+                "names": self.ring.names(),
+                "latest": latest[0]["stats"] if latest else {}}
+
+
 def launch(script: str, script_args: List[str], nproc: int,
            coordinator: str = "127.0.0.1:12355",
            max_restarts: int = 0, log_dir: str = "",
@@ -118,6 +207,15 @@ def launch(script: str, script_args: List[str], nproc: int,
     for r in range(nproc):
         procs[r] = spawn(r)
 
+    scraper: Optional[ClusterScraper] = None
+    if obs_port:
+        # job-level merged timeline: the supervisor serves /clusterz on
+        # the port just past the worker range (obs_port + nproc)
+        from paddlebox_tpu.utils import obs_server
+        scraper = ClusterScraper(
+            [obs_port + r for r in range(nproc)]).start()
+        obs_server.start(port=obs_port + nproc)
+
     exit_code = 0
     try:
         while True:
@@ -154,6 +252,8 @@ def launch(script: str, script_args: List[str], nproc: int,
         return 130
     finally:
         obs_scrape(final=True)
+        if scraper is not None:
+            scraper.stop()
 
 
 def launch_elastic(script: str, script_args: List[str], nproc: int,
@@ -271,6 +371,15 @@ def launch_elastic(script: str, script_args: List[str], nproc: int,
                 p.kill()
 
     procs = {r: spawn(r, world, gen) for r in range(world)}
+    scraper: Optional[ClusterScraper] = None
+    if obs_port:
+        # ports are rank-stable across generations, so one scraper set
+        # covers every generation up to the original nproc; dead or
+        # shrunk-away ranks simply stop answering
+        from paddlebox_tpu.utils import obs_server
+        scraper = ClusterScraper(
+            [obs_port + r for r in range(nproc)]).start()
+        obs_server.start(port=obs_port + nproc)
     sigkills: Dict[int, int] = {}   # rank -> SIGKILL exits across ALL
     # generations (ranks are renumbered per generation; the single-host
     # stand-in treats rank r of every generation as the same "node")
@@ -283,82 +392,86 @@ def launch_elastic(script: str, script_args: List[str], nproc: int,
     # not a partition
     miss_quorum = max(3, int(heartbeat_ttl / 2 / poll_s))
 
-    while True:
-        time.sleep(poll_s)
-        lost, crashed = [], []
-        for r, p in list(procs.items()):
-            ret = p.poll()
-            if ret is None:
-                continue
-            if ret == 0:
-                del procs[r]            # done — leaves quietly
-            elif ret == -signal.SIGKILL:
-                # a lone SIGKILL is indistinguishable from a transient OOM
-                # kill — respawn like a crash; only a REPEAT verdict on
-                # the same rank reads as real node loss and scales in
-                sigkills[r] = sigkills.get(r, 0) + 1
-                (lost if sigkills[r] > 1 else crashed).append(r)
-            else:
-                crashed.append(r)
-        # sustained heartbeat loss of a live, once-registered process =
-        # partitioned
-        alive_hb = {int(k.split("-")[1]) for k in store.alive_keys()}
-        for r, p in list(procs.items()):
-            if p.poll() is None and r in seen_hb and r not in alive_hb:
-                hb_miss[r] = hb_miss.get(r, 0) + 1
-                if hb_miss[r] >= miss_quorum:
-                    p.send_signal(signal.SIGTERM)
-                    lost.append(r)
-            else:
-                hb_miss.pop(r, None)
-        seen_hb |= alive_hb
+    try:
+        while True:
+            time.sleep(poll_s)
+            lost, crashed = [], []
+            for r, p in list(procs.items()):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                if ret == 0:
+                    del procs[r]            # done — leaves quietly
+                elif ret == -signal.SIGKILL:
+                    # a lone SIGKILL is indistinguishable from a transient OOM
+                    # kill — respawn like a crash; only a REPEAT verdict on
+                    # the same rank reads as real node loss and scales in
+                    sigkills[r] = sigkills.get(r, 0) + 1
+                    (lost if sigkills[r] > 1 else crashed).append(r)
+                else:
+                    crashed.append(r)
+            # sustained heartbeat loss of a live, once-registered process =
+            # partitioned
+            alive_hb = {int(k.split("-")[1]) for k in store.alive_keys()}
+            for r, p in list(procs.items()):
+                if p.poll() is None and r in seen_hb and r not in alive_hb:
+                    hb_miss[r] = hb_miss.get(r, 0) + 1
+                    if hb_miss[r] >= miss_quorum:
+                        p.send_signal(signal.SIGTERM)
+                        lost.append(r)
+                else:
+                    hb_miss.pop(r, None)
+            seen_hb |= alive_hb
 
-        if not procs and not lost and not crashed:
-            return 0                    # final generation all done
-        if lost or crashed:
-            # failures spend relaunch budget
-            if relaunches >= max_relaunches:
-                stop_all(procs)
-                return 75               # EX_TEMPFAIL: budget exhausted
-            relaunches += 1
-            grow = read_grow()
-        else:
-            # voluntary scale-out: free (no failure happened); a healthy
-            # job must never die because a grow request arrived after the
-            # failure budget was spent
-            grow = read_grow(peek=True)
-            if not grow:
-                continue
-            if min(len(procs) + grow, nproc) <= len(procs):
-                continue                # at the nproc cap — leave pending
-            read_grow()                 # honored now: consume it
+            if not procs and not lost and not crashed:
+                return 0                    # final generation all done
+            if lost or crashed:
+                # failures spend relaunch budget
+                if relaunches >= max_relaunches:
+                    stop_all(procs)
+                    return 75               # EX_TEMPFAIL: budget exhausted
+                relaunches += 1
+                grow = read_grow()
+            else:
+                # voluntary scale-out: free (no failure happened); a healthy
+                # job must never die because a grow request arrived after the
+                # failure budget was spent
+                grow = read_grow(peek=True)
+                if not grow:
+                    continue
+                if min(len(procs) + grow, nproc) <= len(procs):
+                    continue                # at the nproc cap — leave pending
+                read_grow()                 # honored now: consume it
 
-        # -- re-rendezvous ------------------------------------------------
-        # stop EVERYTHING first — including just-SIGTERMed partitioned
-        # ranks, so they get the kill escalation + reap and can never keep
-        # mutating shared state (the checkpoint) beside the new generation
-        stop_all(procs)
-        for r in lost + crashed:
-            procs.pop(r, None)
-        for k in store.alive_keys():    # clean the prefix for the new gen
-            store.delete(k)
-        survivors = len(procs) + len(crashed)
-        new_world = min(survivors + grow, nproc)
-        if new_world < min_workers:
-            return 76                   # below quorum
-        gen += 1
-        if new_world > world:
-            flight.record("elastic_grow", gen=gen, world=new_world,
-                          grew=new_world - world)
-        elif new_world < world:
-            flight.record("elastic_scale_in", gen=gen, world=new_world,
-                          lost=len(lost), crashed=len(crashed))
-        flight.record("elastic_rerendezvous", gen=gen, world=new_world,
-                      survivors=survivors, grow=grow)
-        world = new_world
-        procs = {r: spawn(r, world, gen) for r in range(world)}
-        seen_hb = set()
-        hb_miss = {}
+            # -- re-rendezvous ------------------------------------------------
+            # stop EVERYTHING first — including just-SIGTERMed partitioned
+            # ranks, so they get the kill escalation + reap and can never keep
+            # mutating shared state (the checkpoint) beside the new generation
+            stop_all(procs)
+            for r in lost + crashed:
+                procs.pop(r, None)
+            for k in store.alive_keys():    # clean the prefix for the new gen
+                store.delete(k)
+            survivors = len(procs) + len(crashed)
+            new_world = min(survivors + grow, nproc)
+            if new_world < min_workers:
+                return 76                   # below quorum
+            gen += 1
+            if new_world > world:
+                flight.record("elastic_grow", gen=gen, world=new_world,
+                              grew=new_world - world)
+            elif new_world < world:
+                flight.record("elastic_scale_in", gen=gen, world=new_world,
+                              lost=len(lost), crashed=len(crashed))
+            flight.record("elastic_rerendezvous", gen=gen, world=new_world,
+                          survivors=survivors, grow=grow)
+            world = new_world
+            procs = {r: spawn(r, world, gen) for r in range(world)}
+            seen_hb = set()
+            hb_miss = {}
+    finally:
+        if scraper is not None:
+            scraper.stop()
 
 
 class PSServerSupervisor:
@@ -554,6 +667,18 @@ def main():
                          "(FLAGS_obs_postmortem_dir; SIGUSR1 on any "
                          "worker writes one).  empty = <tmpdir>/"
                          "pbox-postmortems")
+    ap.add_argument("--obs_timeline_interval_s", type=float, default=None,
+                    help="telemetry-timeline sample cadence on every "
+                         "worker (FLAGS_obs_timeline_interval_s; serves "
+                         "/timelinez, feeds the SLO watchdog, embeds in "
+                         "postmortems).  0 = off")
+    ap.add_argument("--obs_timeline_ring", type=int, default=None,
+                    help="timeline ring capacity per worker "
+                         "(FLAGS_obs_timeline_ring; newest-N samples)")
+    ap.add_argument("--obs_slo_watchdog", type=int, default=None,
+                    help="evaluate the SLO rule set on every timeline "
+                         "sample (FLAGS_obs_slo_watchdog; breaches emit "
+                         "latched slo_breach flight events).  1 = on")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
@@ -590,6 +715,16 @@ def main():
     if args.obs_postmortem_dir:
         # pboxlint: disable-next=PB203 -- env export to spawned workers
         os.environ["FLAGS_obs_postmortem_dir"] = args.obs_postmortem_dir
+    if args.obs_timeline_interval_s is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_obs_timeline_interval_s"] = str(
+            args.obs_timeline_interval_s)
+    if args.obs_timeline_ring is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_obs_timeline_ring"] = str(args.obs_timeline_ring)
+    if args.obs_slo_watchdog is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_obs_slo_watchdog"] = str(args.obs_slo_watchdog)
     if args.auto_resume:
         # pboxlint: disable-next=PB203 -- env export to spawned workers
         os.environ["FLAGS_auto_resume"] = str(args.auto_resume)

@@ -259,6 +259,19 @@ class AucCalculator:
         }
         return out
 
+    def folded_buckets(self, bins: int = 50) -> "tuple[np.ndarray, np.ndarray]":
+        """Fold the pos/neg bucket tables down to ``bins`` coarse buckets
+        (exact counts, reduced resolution) — the compact per-pass export
+        the windowed-AUC / drift monitors (metrics/quality.py) retain
+        across passes without holding the 1M-bucket tables."""
+        bins = max(1, int(bins))
+        idx = (np.arange(self.table_size) * bins) // self.table_size
+        pos = np.zeros((bins,), np.float64)
+        neg = np.zeros((bins,), np.float64)
+        np.add.at(pos, idx, self._pos)
+        np.add.at(neg, idx, self._neg)
+        return pos, neg
+
     def _bucket_error(self) -> float:
         """≙ calculate_bucket_error (metrics.cc:373-410): merge adjacent
         buckets until the adjusted-ctr estimate is statistically tight, then

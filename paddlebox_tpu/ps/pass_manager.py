@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from paddlebox_tpu import flags
 from paddlebox_tpu.config import EmbeddingTableConfig
+from paddlebox_tpu.metrics import quality
 from paddlebox_tpu.parallel.topology import HybridTopology
 from paddlebox_tpu.ps import embedding, faults
 from paddlebox_tpu.ps.device_cache import CachePlan, DeviceRowCache
@@ -104,6 +105,8 @@ class BoxPSEngine:
             flight.record("day_end", day=self.day_id, next_day=date)
             with self.timers("end_day"):
                 self.table.end_day()
+            # day-scale concept-drift rollover (quality.psi.day)
+            quality.end_day(self.day_id)
             # coherence point: end_day decayed show/click table-wide —
             # every cached row is stale now (the prefetcher's day-boundary
             # drain guarantees no feed snapshot is in flight here)
@@ -695,6 +698,17 @@ class BoxPSEngine:
                 f"restores={int(delta('ckpt.restore_s.count'))} "
                 f"restore_s={delta('ckpt.restore_s.sum'):.3f} "
                 f"generation={int(cur.get('ckpt.generation', -1))}")
+        q = stat_snapshot("quality.")
+        if q.get("quality.passes"):
+            # training-quality trajectory (metrics/quality.py): the
+            # latest pass's AUC next to its windowed value and the drift
+            # monitors the SLO watchdog reads
+            lines.append(
+                f"  quality: auc={q.get('quality.auc', 0.0):.4f} "
+                f"auc_window={q.get('quality.auc_window', 0.0):.4f} "
+                f"auc_drop={q.get('quality.auc_drop', 0.0):.4f} "
+                f"calib_drift={q.get('quality.calibration_drift', 0.0):.4f} "
+                f"psi={q.get('quality.psi.prediction', 0.0):.4f}")
         rep = getattr(self, "_pass_feed_report", None)
         if rep:
             # interval-accounted utilization (utils/intervals.py): how

@@ -137,11 +137,12 @@ def ALL_CHECKERS():
     from paddlebox_tpu.tools.pboxlint import (atomic_io, device_cache,
                                               flags_hygiene, flight_events,
                                               lifecycle, lockgraph, locks,
-                                              metric_names, purity, retries)
+                                              metric_names, purity, retries,
+                                              slo_rules)
     return (locks.check, flags_hygiene.check, metric_names.check,
             flight_events.check, purity.check, lifecycle.check,
             retries.check, atomic_io.check, device_cache.check,
-            lockgraph.check)
+            lockgraph.check, slo_rules.check)
 
 
 def lint_modules(modules: Sequence[Module]) -> List[Finding]:

@@ -1,0 +1,146 @@
+"""PB2xx (cont.) — SLO-rule metric cross-check (utils/timeline.py).
+
+  PB207  an ``SloRule(...)`` construction names a metric that no
+         ``stat_add``/``stat_set``/``stat_max``/``stat_observe`` call
+         site anywhere in the linted set actually emits — a dead rule:
+         its series stays empty, it can never breach, and the SLO it was
+         meant to guard is silently unwatched.  The watchdog face of
+         PB205's dead-knob detection: a flag nobody reads changes
+         nothing; a rule watching a metric nobody emits alarms on
+         nothing.
+
+Emitted names are collected as literals plus f-string patterns (each
+interpolation matched as a bounded ``[a-z0-9_.]+`` segment, the PB204
+name alphabet); ``stat_observe`` names also contribute their derived
+histogram keys (``.count/.sum/.p50/.p95/.p99/.max``), since rules read
+the flattened snapshot the timeline samples.  Rule sites are resolved
+through each module's imports of ``paddlebox_tpu.utils.timeline`` (the
+PB206 sink-resolution approach), so unrelated ``SloRule`` classes are
+out of scope.  Disarmed entirely when any emission site uses a fully
+dynamic name (the emitted set is then out of static reach), and per
+rule when the metric argument is non-literal.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set, Tuple
+
+from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
+                                               PackageContext, dotted_name)
+
+_EMIT_SINKS = {"stat_add", "stat_set", "stat_max", "stat_observe"}
+_HIST_SUFFIXES = (".count", ".sum", ".p50", ".p95", ".p99", ".max")
+_DYN_SEGMENT = r"[a-z0-9_.]+"       # PB204's metric-name alphabet
+_TIMELINE_MOD = "paddlebox_tpu.utils.timeline"
+
+# emitted by dict write inside StatRegistry.observe (monitor.py), not
+# through a stat_* wrapper — the one name the call-site sweep can't see
+_BUILTIN_EMITS = {"obs.non_finite_dropped"}
+
+
+def _collect_emitted(ctx: PackageContext
+                     ) -> Tuple[Set[str], List[str], bool]:
+    """→ (literal names, f-string regex patterns, any-dynamic-emit).
+    Memoized on the context — one sweep per lint run."""
+    cached = getattr(ctx, "_pb207_emitted", None)
+    if cached is not None:
+        return cached
+    literals: Set[str] = set(_BUILTIN_EMITS)
+    patterns: List[str] = []
+    dynamic = False
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            tail = dotted_name(node.func).rsplit(".", 1)[-1]
+            if tail not in _EMIT_SINKS:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                literals.add(arg.value)
+                if tail == "stat_observe":
+                    literals.update(arg.value + s for s in _HIST_SUFFIXES)
+            elif isinstance(arg, ast.JoinedStr):
+                parts = []
+                for part in arg.values:
+                    if isinstance(part, ast.Constant):
+                        parts.append(re.escape(str(part.value)))
+                    else:
+                        parts.append(_DYN_SEGMENT)
+                pat = "".join(parts)
+                patterns.append(pat + r"\Z")
+                if tail == "stat_observe":
+                    patterns.extend(pat + re.escape(s) + r"\Z"
+                                    for s in _HIST_SUFFIXES)
+            else:
+                dynamic = True      # emitted set out of static reach
+    out = (literals, patterns, dynamic)
+    ctx._pb207_emitted = out
+    return out
+
+
+def _rule_sinks(mod: Module) -> Set[str]:
+    """Dotted call names in this module that resolve to
+    timeline.SloRule — plus the bare name inside timeline.py itself
+    (where default_rules constructs them)."""
+    sinks: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == _TIMELINE_MOD:
+                    sinks.add(f"{alias.asname or alias.name}.SloRule")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "paddlebox_tpu.utils":
+                for alias in node.names:
+                    if alias.name == "timeline":
+                        sinks.add(f"{alias.asname or 'timeline'}.SloRule")
+            elif node.module == _TIMELINE_MOD:
+                for alias in node.names:
+                    if alias.name == "SloRule":
+                        sinks.add(alias.asname or "SloRule")
+        elif isinstance(node, ast.ClassDef) and node.name == "SloRule":
+            sinks.add("SloRule")
+    return sinks
+
+
+def _metric_arg(call: ast.Call) -> "ast.AST | None":
+    """SloRule(name, metric, ...): positional #2 or metric= kwarg."""
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "metric":
+            return kw.value
+    return None
+
+
+def check(mod: Module, ctx: PackageContext) -> List[Finding]:
+    sinks = _rule_sinks(mod)
+    if not sinks:
+        return []
+    literals, patterns, dynamic = _collect_emitted(ctx)
+    if dynamic:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted_name(node.func) not in sinks:
+            continue
+        arg = _metric_arg(node)
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue            # dynamic metric name: out of static reach
+        metric = arg.value
+        if metric in literals:
+            continue
+        if any(re.match(p, metric) for p in patterns):
+            continue
+        findings.append(Finding(
+            mod.path, node.lineno, "PB207",
+            f"SLO rule watches metric {metric!r} but no stat_add/"
+            f"stat_set/stat_max/stat_observe call site anywhere in the "
+            f"linted set emits that name — the rule's series stays "
+            f"empty and it can never breach (dead rule)"))
+    return findings

@@ -1059,11 +1059,17 @@ class SparseTrainer:
                 except ChannelClosed:
                     break
                 dev = self._put_batch(batch)
+                t_step = time.perf_counter()
                 m_step = time.monotonic()
                 with self.timers("step"):
                     out = self._step_fn(ws, params, opt_state, auc_state,
                                         *dev)
                 intervals.record("device", m_step, time.monotonic())
+                # same per-batch dispatch distribution as the packed loop:
+                # the SLO watchdog's throughput-stall rule rates this
+                # counter, so BOTH train paths must feed it
+                stat_observe("trainer.step_dispatch_s",
+                             time.perf_counter() - t_step)
                 if self.async_dense is not None:
                     (ws, params, opt_state, auc_state, loss, preds,
                      d_params) = out
@@ -1120,6 +1126,11 @@ class SparseTrainer:
         self.auc.reset()
         self.auc.merge_device_state(jax.device_get(auc_state))
         out = self.auc.compute()
+        # compact folded pos/neg export: the windowed-AUC / PSI-drift
+        # monitors (metrics/quality.py) retain this across passes instead
+        # of the 1M-bucket tables
+        pos, neg = self.auc.folded_buckets()
+        out["auc_buckets"] = {"pos": pos.tolist(), "neg": neg.tolist()}
         if self.wuauc is not None:
             w = self.wuauc.compute()
             out["uauc"] = w["uauc"]

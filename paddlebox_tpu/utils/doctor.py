@@ -101,6 +101,16 @@ def dump_state(reason: str = "", flight_n: int = _FLIGHT_N) -> Dict:
             bundle["lockdep"] = lockdep.state()
     except Exception as e:
         bundle["lockdep"] = {"error": repr(e)}
+    try:                            # lazy: avoid an import cycle with
+        from paddlebox_tpu.utils import timeline  # obs_server→doctor
+        s = timeline.sampler()
+        if s is not None:
+            # the minutes LEADING UP TO the wedge, not just its instant
+            bundle["timeline"] = {"interval_s": s.interval_s,
+                                  "slo": s.watchdog.states(),
+                                  "tail": timeline.tail()}
+    except Exception as e:
+        bundle["timeline"] = {"error": repr(e)}
     return bundle
 
 

@@ -247,6 +247,20 @@ class StatRegistry:
             self._stats.clear()
             self._hists.clear()
 
+    def remove_prefix(self, prefix: str) -> int:
+        """Drop every stat and histogram under a dotted prefix; returns
+        how many were removed.  For subsystem resets (quality.reset):
+        a gauge left behind by a discarded model would keep feeding the
+        timeline sampler and SLO watchdog as if it were current."""
+        with self._lock:
+            ks = [k for k in self._stats if _prefix_match(k, prefix)]
+            hs = [k for k in self._hists if _prefix_match(k, prefix)]
+            for k in ks:
+                del self._stats[k]
+            for k in hs:
+                del self._hists[k]
+            return len(ks) + len(hs)
+
 
 def stat_add(name: str, value: float = 1.0) -> None:
     StatRegistry.instance().add(name, value)

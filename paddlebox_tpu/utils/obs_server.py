@@ -13,8 +13,18 @@ exposition, PAPERS.md):
                 (utils/trace.py), JSON.
   ``/flightz``  newest-N flight-recorder events (utils/flight.py);
                 ``?n=`` and ``?kind=`` filter.
+  ``/timelinez`` the telemetry timeline (utils/timeline.py): index +
+                SLO watchdog states, or one metric's value/rate series
+                via ``?name=&n=``.
+  ``/clusterz`` the job-level merged timeline — answered by the
+                launch.py supervisor's cluster scraper (registered via
+                ``set_clusterz_provider``); workers answer
+                ``enabled=False``.
   ``/debugz``   a full wedge-doctor bundle (utils/doctor.py): all-thread
                 stacks + flight ring + stat snapshot + workpool state.
+
+``/statz`` and ``/metrics`` accept ``?prefix=`` (dotted-segment match,
+monitor._prefix_match) so scrapers can pull narrow slices.
 
 Off by default: ``FLAGS_obs_port`` = 0 starts nothing and no
 instrumentation site pays more than an is-None/flag check.  launch.py
@@ -35,7 +45,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from paddlebox_tpu import flags
-from paddlebox_tpu.utils import doctor, flight, trace
+from paddlebox_tpu.utils import doctor, flight, timeline, trace
 from paddlebox_tpu.utils.monitor import Histogram, StatRegistry
 
 flags.define_flag(
@@ -64,16 +74,17 @@ def _prom_val(v: float) -> str:
     return "+Inf" if f > 0 else "-Inf"
 
 
-def render_prometheus() -> str:
+def render_prometheus(prefix: str = "") -> str:
     """Prometheus text exposition (version 0.0.4) of the registry:
-    plain stats as gauges, histograms as summaries."""
+    plain stats as gauges, histograms as summaries.  ``prefix`` narrows
+    to one dotted subtree (the ``?prefix=`` scrape filter)."""
     reg = StatRegistry.instance()
     lines: List[str] = []
-    for name, val in sorted(reg.counter_snapshot().items()):
+    for name, val in sorted(reg.counter_snapshot(prefix).items()):
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} gauge")
         lines.append(f"{pn} {_prom_val(val)}")
-    for name, summ in sorted(reg.hist_snapshot().items()):
+    for name, summ in sorted(reg.hist_snapshot(prefix).items()):
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} summary")
         for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
@@ -87,17 +98,19 @@ def render_prometheus() -> str:
 HIST_RAW_KEY = "_hist_raw"
 
 
-def render_statz(raw: bool = False) -> str:
+def render_statz(raw: bool = False, prefix: str = "") -> str:
     """The flat JSON snapshot.  Non-finite gauges are OMITTED — bare
     ``Infinity``/``NaN`` tokens are invalid JSON and would break every
     strict consumer of the scrape.  ``raw=True`` adds ``_hist_raw``
     (sparse bucket counts per histogram) for bucket-wise supervisor
-    merging."""
+    merging; ``prefix`` narrows both to one dotted subtree so the
+    cluster scraper (and external Prometheus) can pull slices instead
+    of the full snapshot every interval."""
     reg = StatRegistry.instance()
-    out: Dict = {k: v for k, v in reg.snapshot().items()
+    out: Dict = {k: v for k, v in reg.snapshot(prefix).items()
                  if math.isfinite(v)}
     if raw:
-        out[HIST_RAW_KEY] = reg.hist_raw()
+        out[HIST_RAW_KEY] = reg.hist_raw(prefix)
     return json.dumps(out, sort_keys=True)
 
 
@@ -117,6 +130,48 @@ def render_flightz(n: int = 256, kind: Optional[str] = None) -> str:
     }, default=str)
 
 
+def render_timelinez(name: Optional[str] = None,
+                     n: Optional[int] = None) -> str:
+    """The telemetry timeline (utils/timeline.py): without ``name`` an
+    index (names + watchdog states), with it one metric's value/rate
+    series."""
+    s = timeline.sampler()
+    if name:
+        return json.dumps(timeline.series(name, n=n))
+    return json.dumps({
+        "enabled": s is not None,
+        "interval_s": s.interval_s if s is not None else 0.0,
+        "len": len(s.ring) if s is not None else 0,
+        "names": s.ring.names() if s is not None else [],
+        "slo": {
+            "states": s.watchdog.states() if s is not None else {},
+            "rules": [r.describe() for r in s.watchdog.rules]
+            if s is not None else [],
+        },
+    })
+
+
+# -- /clusterz provider (supervisor-side) -----------------------------------
+# launch.py's cluster scraper registers a callable here; worker processes
+# have none and answer /clusterz with enabled=False.
+_CLUSTERZ: Optional[object] = None
+
+
+def set_clusterz_provider(fn) -> None:
+    """Register ``fn(name=None, n=None) -> dict`` as the /clusterz
+    source (the supervisor's ClusterScraper); None unregisters."""
+    global _CLUSTERZ
+    _CLUSTERZ = fn
+
+
+def render_clusterz(name: Optional[str] = None,
+                    n: Optional[int] = None) -> str:
+    fn = _CLUSTERZ
+    if fn is None:
+        return json.dumps({"enabled": False})
+    return json.dumps(fn(name=name, n=n), default=str)
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):        # no stderr spam per scrape
         pass
@@ -125,12 +180,14 @@ class _Handler(BaseHTTPRequestHandler):
         path, _, qs = self.path.partition("?")
         q = urllib.parse.parse_qs(qs)
         try:
+            prefix = q.get("prefix", [""])[0]
             if path == "/metrics":
-                body = render_prometheus()
+                body = render_prometheus(prefix=prefix)
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/statz":
                 raw = q.get("raw", ["0"])[0] not in ("", "0")
-                body, ctype = render_statz(raw=raw), "application/json"
+                body, ctype = render_statz(raw=raw, prefix=prefix), \
+                    "application/json"
             elif path == "/tracez":
                 body, ctype = render_tracez(), "application/json"
             elif path == "/flightz":
@@ -138,12 +195,24 @@ class _Handler(BaseHTTPRequestHandler):
                 kind = q.get("kind", [None])[0]
                 body, ctype = render_flightz(n=n, kind=kind), \
                     "application/json"
+            elif path == "/timelinez":
+                name = q.get("name", [None])[0]
+                n_s = q.get("n", [None])[0]
+                body, ctype = render_timelinez(
+                    name=name, n=int(n_s) if n_s else None), \
+                    "application/json"
+            elif path == "/clusterz":
+                name = q.get("name", [None])[0]
+                n_s = q.get("n", [None])[0]
+                body, ctype = render_clusterz(
+                    name=name, n=int(n_s) if n_s else None), \
+                    "application/json"
             elif path == "/debugz":
                 body, ctype = doctor.render_debugz(), "application/json"
             else:
                 self.send_error(404, "unknown path (want /metrics, "
                                      "/statz, /tracez, /flightz, "
-                                     "/debugz)")
+                                     "/timelinez, /clusterz, /debugz)")
                 return
         except Exception as e:  # noqa: BLE001 — a scrape must never kill
             self.send_error(500, repr(e))
@@ -201,6 +270,7 @@ def maybe_start_from_flags() -> Optional[ObsServer]:
     set (launch.py exports base+rank per worker); always honors
     ``FLAGS_obs_trace`` for the tracer alone."""
     trace.maybe_enable_from_flags()
+    timeline.maybe_start_from_flags()
     port = int(flags.get_flags("obs_port"))
     if port <= 0:
         return None

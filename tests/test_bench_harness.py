@@ -309,6 +309,32 @@ def test_compare_flags_feed_gap_regression_and_threshold(bench, monkeypatch,
     assert bench.compare(old, new, threshold=0.6) == 0    # within 60%
 
 
+def test_compare_feed_gap_gate_skipped_when_device_idle(bench, monkeypatch,
+                                                        tmp_path):
+    """CPU-basis records carry device_busy_frac ~ 0: the gap ratio's
+    denominator is milliseconds of device time, so a timing wobble
+    swings it by double digits — the gate must not arm (the delta is
+    still reported, flagged degenerate).  A real device measurement
+    keeps it armed."""
+    def rf(path, gap, db):
+        path.write_text(json.dumps(
+            {"metric": "m", "value": 1000.0, "final": True,
+             "feed_gap_ratio": gap, "device_busy_frac": db}))
+        return str(path)
+
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    assert bench.compare(rf(tmp_path / "o1.json", 2.0, 0.0001),
+                         rf(tmp_path / "n1.json", 3.0, 0.0002),
+                         threshold=0.05) == 0
+    rep = json.loads(out.getvalue())
+    assert rep["feed_gap_ratio"]["degenerate"] is True
+    monkeypatch.setattr(sys, "stdout", io.StringIO())
+    assert bench.compare(rf(tmp_path / "o2.json", 2.0, 0.5),
+                         rf(tmp_path / "n2.json", 3.0, 0.5),
+                         threshold=0.05) == 1
+
+
 def test_compare_cli_dispatch(tmp_path):
     import subprocess
     old = _result_file(tmp_path / "old.json", 1000.0, 2.0)
