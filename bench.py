@@ -55,6 +55,8 @@ BENCH_NO_SUPERVISE=1 (single-process debug mode),
 BENCH_COMPARE_THRESHOLD (default regression threshold for --compare),
 BENCH_CACHE=0 (skip the device-cache on/off compare),
 BENCH_CACHE_PASSES/_KEYS/_DRAWS/_ROWS (cache-compare geometry),
+BENCH_SERVING=0 (skip the serving-tier QPS/p99 phase),
+BENCH_SERVING_KEYS/_BATCHES/_BATCH (serving-phase geometry),
 BENCH_TIMELINE_S (telemetry-timeline sampler cadence, default 1.0;
 0 disables — the run's `timeline` summary then stays empty).
 """
@@ -598,6 +600,98 @@ def _cache_compare(tag):
             "wire_reduction": round(reduction, 2)}
 
 
+def _serving_bench(tag):
+    """Serving-tier phase: batched-pull QPS + p99 against a live
+    ServingReplica over the real wire path (PSClient pipelining, frozen
+    tables, per-tenant admission) on a zipf-skewed key stream — the
+    inference-side complement of the training headline.  Builds a small
+    trained-shaped table, save_xbox's it (rows seeded above the base
+    threshold so the dump is non-empty), serves it from a fresh replica,
+    and drives the router exactly like an inference frontend would."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from paddlebox_tpu.config import EmbeddingTableConfig
+    from paddlebox_tpu.io.checkpoint import save_xbox
+    from paddlebox_tpu.ps.host_table import ShardedHostTable
+    from paddlebox_tpu.ps.serving import ServingReplica, ServingRouter
+    from paddlebox_tpu.utils.monitor import stat_snapshot
+
+    n_keys = int(os.environ.get("BENCH_SERVING_KEYS", 50_000))
+    n_batches = int(os.environ.get("BENCH_SERVING_BATCHES", 200))
+    batch = int(os.environ.get("BENCH_SERVING_BATCH", 2048))
+    mf_dim = 8
+
+    cfg = EmbeddingTableConfig(embedding_dim=mf_dim, shard_num=8)
+    table = ShardedHostTable(cfg, seed=0)
+    rng = np.random.default_rng(11)
+    keys = (rng.choice(2 ** 40, n_keys, replace=False)
+            .astype(np.uint64))
+    rows = table.bulk_pull(keys)
+    # score = 0.1*(show-click) + 1.0*click must clear base_threshold
+    # (1.5) or save_xbox filters the row and the dump comes out empty
+    rows["show"] = rows["show"] + 20.0
+    rows["click"] = rows["click"] + 5.0
+    rows["mf_size"][:] = mf_dim
+    rows["mf"][:] = rng.standard_normal(rows["mf"].shape) \
+        .astype(np.float32)
+    table.bulk_write(keys, rows)
+
+    class _Eng:
+        pass
+    eng = _Eng()
+    eng.table, eng.config = table, cfg
+
+    root = _tempfile.mkdtemp(prefix="bench_serving_")
+    rep = router = None
+    try:
+        dump = os.path.join(root, "xbox_base")
+        save_xbox(eng, dump, base=True)
+        t0 = time.perf_counter()
+        rep = ServingReplica(config=cfg, xbox_path=dump, port=0)
+        load_s = time.perf_counter() - t0
+        router = ServingRouter([rep.addr])
+
+        # zipf over the RESIDENT keys (hot-set skew, all hits) plus a
+        # tail of misses — the production mix a frontend actually sends
+        draws = np.minimum(rng.zipf(1.3, size=(n_batches, batch)),
+                           n_keys) - 1
+        batches = [keys[d] for d in draws]
+        warm = stat_snapshot("serving.")
+
+        def delta(key):
+            return (stat_snapshot("serving.").get(key, 0.0)
+                    - warm.get(key, 0.0))
+
+        router.pull_sparse(batches[0])          # connect + compile warm
+        t0 = time.perf_counter()
+        for i, b in enumerate(batches):
+            if i % 50 == 0:
+                set_phase(f"{tag}:serving[{i}/{n_batches}]", 300)
+            router.pull_sparse(b)
+        wall = time.perf_counter() - t0
+
+        snap = stat_snapshot("serving.")
+        p99_s = float(snap.get("serving.default.latency_s.p99", 0.0))
+        p50_s = float(snap.get("serving.default.latency_s.p50", 0.0))
+        queries = delta("serving.default.qps") or float(n_batches)
+        shed = delta("serving.default.shed")
+        return {"qps": round(n_batches / max(wall, 1e-9), 1),
+                "keys_per_s": round(n_batches * batch / max(wall, 1e-9)),
+                "p50_ms": round(p50_s * 1000, 3),
+                "p99_ms": round(p99_s * 1000, 3),
+                "shed_rate": round(shed / max(queries, 1.0), 4),
+                "batch": batch, "batches": n_batches,
+                "resident_keys": n_keys, "zipf_a": 1.3,
+                "load_s": round(load_s, 3)}
+    finally:
+        if router is not None:
+            router.close()
+        if rep is not None:
+            rep.shutdown()
+        _shutil.rmtree(root, ignore_errors=True)
+
+
 def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     """One full bench at a given geometry.  Returns the results dict;
     records partials into _STATE as they are measured."""
@@ -832,9 +926,24 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
         except Exception as e:  # comparison is diagnostic, never fatal
             trace(f"{tag}: cache-compare failed: {type(e).__name__}: {e}")
 
+    serving = {}
+    if tag == "full" and not legacy \
+            and os.environ.get("BENCH_SERVING", "1") == "1":
+        set_phase(f"{tag}:serving", 600)
+        try:
+            serving = _serving_bench(tag)
+            record(serving_qps=serving["qps"],
+                   serving_p99_ms=serving["p99_ms"])
+            trace(f"{tag}: serving qps={serving['qps']:.1f} "
+                  f"({serving['keys_per_s']:,} keys/s) "
+                  f"p99={serving['p99_ms']:.2f}ms "
+                  f"shed_rate={serving['shed_rate']:.4f}")
+        except Exception as e:  # phase is diagnostic, never fatal
+            trace(f"{tag}: serving bench failed: {type(e).__name__}: {e}")
+
     return {"e2e": e2e_eps, "device_step": device_eps,
             "pass_cycle": pass_cycle, "recovery": recovery,
-            "cache": cache_cmp,
+            "cache": cache_cmp, "serving": serving,
             "batches": int(stats["batches"]), "examples": int(n_examples),
             "auc": round(float(stats.get("auc", float("nan"))), 4),
             "compile_s": round(compile_s, 1), "pass_pack_s": round(pack_s, 1),
@@ -923,7 +1032,7 @@ def run() -> None:
          device_busy_frac=full["device_busy_frac"],
          feed_gap_ratio=full["feed_gap_ratio"],
          pass_cycle=full["pass_cycle"], recovery=full["recovery"],
-         cache=full["cache"],
+         cache=full["cache"], serving=full["serving"],
          feed_intervals=full["feed_intervals"], timers=full["timers"],
          timeline=_timeline_summary(), obs_stats=_obs_snapshot())
 
@@ -1268,6 +1377,29 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
             regressions.append(
                 f"cache.wire_reduction {wo:.2f}x -> {wn:.2f}x "
                 f"({wfrac:+.1%})")
+    svo, svn = old.get("serving") or {}, new.get("serving") or {}
+    qo, qn = num(svo, "qps"), num(svn, "qps")
+    if qo and qn is not None:           # lower serving QPS = regression
+        qfrac = (qn - qo) / qo
+        out["serving_qps"] = {"old": qo, "new": qn,
+                              "delta_frac": round(qfrac, 4)}
+        if qfrac < -threshold:
+            regressions.append(
+                f"serving.qps {qo:.1f} -> {qn:.1f} ({qfrac:+.1%})")
+    po, pn = num(svo, "p99_ms"), num(svn, "p99_ms")
+    if po and pn is not None:           # higher serving p99 = regression
+        pfrac = (pn - po) / po
+        out["serving_p99_ms"] = {"old": po, "new": pn,
+                                 "delta_frac": round(pfrac, 4)}
+        if pfrac > threshold:
+            regressions.append(
+                f"serving.p99_ms {po:.2f} -> {pn:.2f} ({pfrac:+.1%})")
+    sho, shn = num(svo, "shed_rate") or 0.0, num(svn, "shed_rate")
+    if shn is not None:                 # new sustained shed = regression
+        out["serving_shed_rate"] = {"old": sho, "new": shn}
+        if shn > sho + 0.01:
+            regressions.append(
+                f"serving.shed_rate {sho:.4f} -> {shn:.4f}")
     mo = num(old.get("recovery") or {}, "mttr_s")
     mn = num(new.get("recovery") or {}, "mttr_s")
     if mo and mn is not None:           # slower recovery = regression

@@ -507,4 +507,53 @@ def load_xbox(engine: BoxPSEngine, path: str) -> np.ndarray:
         if fld not in keep:
             rows[fld] = np.zeros_like(rows[fld])
     engine.table.bulk_write(keys, rows)
+    # coherence point (hot-swap contract): the rows just changed UNDER
+    # every consumer that mirrors them.  A device-resident row cache now
+    # holds the retired day's values, and a PSClient's learned row-width
+    # estimates were sized from the old contents — both must drop HERE,
+    # not just in freeze_for_serving (a replica that load_xbox'es day N+1
+    # over day N never calls freeze again).
+    cache = getattr(engine, "cache", None)
+    if cache is not None:
+        cache.invalidate("load_xbox")
+    inval = getattr(engine.table, "invalidate_row_width", None)
+    if inval is not None:
+        inval()
     return keys
+
+
+# -- xbox swap manifest (train→serve day pointer) ---------------------------
+# The dump itself lands via save_xbox's tmp+rename; this publishes WHICH
+# dump is current — the trainer's last act of a day, the serving fleet's
+# swap trigger (ServingReplica.watch_manifest).  Same discipline as the
+# checkpoint MANIFEST: one mutable file, swapped whole via _atomic_write,
+# so a reader sees the old complete pointer or the new one, never a torn
+# write or a pointer to a half-written dump.
+XBOX_MANIFEST = "XBOX_MANIFEST.json"
+
+
+def publish_xbox_manifest(root: str, path: str, generation: int,
+                          day: str = "") -> str:
+    """Atomically point ``<root>/XBOX_MANIFEST.json`` at the dump at
+    ``path`` (already fully written — call this AFTER save_xbox
+    returns).  Returns the manifest path."""
+    os.makedirs(root, exist_ok=True)
+    man = os.path.join(root, XBOX_MANIFEST)
+    _atomic_write(man, json.dumps(
+        {"generation": int(generation), "path": path, "day": day,
+         "published_unix": time.time()}).encode())
+    return man
+
+
+def read_xbox_manifest(root: str) -> Optional[Dict]:
+    """The current swap pointer, or None when nothing is published yet.
+    Raises on a malformed manifest — tmp+rename means a torn file is a
+    bug upstream, not a transient to paper over."""
+    man = os.path.join(root, XBOX_MANIFEST)
+    if not os.path.exists(man):
+        return None
+    with open(man, "r") as f:
+        out = json.load(f)
+    if "generation" not in out or "path" not in out:
+        raise ValueError(f"malformed xbox manifest {man}: {out!r}")
+    return out

@@ -582,6 +582,160 @@ class PSServerSupervisor:
         self.server.shutdown()
 
 
+class ServingReplicaSupervisor:
+    """PSServerSupervisor's serving-tier sibling: own a ServingReplica,
+    watch it, restart it in place when it dies.  Restart keeps the
+    router's world intact: the new replica binds the SAME port, inherits
+    the dead instance's dedup window, and re-resolves the CURRENT xbox
+    swap manifest before serving — a replica that died on day N and
+    restarts after the trainer published day N+1 comes back serving
+    N+1, not a stale dump.  ``stop()`` joins the watch and drains the
+    replica (PB405 lifecycle)."""
+
+    def __init__(self, config=None, xbox_path: Optional[str] = None,
+                 manifest_root: Optional[str] = None, tenants=None,
+                 max_inflight: Optional[int] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_restarts: int = 8, backoff_base: float = 0.05,
+                 backoff_cap: float = 1.0, poll_s: float = 0.02,
+                 watch_s: float = 0.0, seed: int = 0):
+        from paddlebox_tpu.ps.serving import ServingReplica
+        self._make = ServingReplica
+        self.config = config
+        self.xbox_path = xbox_path
+        self.manifest_root = manifest_root
+        self.tenants = tenants
+        self.max_inflight = max_inflight
+        self.host = host
+        self.watch_s = watch_s
+        self.seed = seed
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self._backoff = (backoff_base, backoff_cap)
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        path, day, gen = self._resolve_dump()
+        self.replica = ServingReplica(
+            config=config, xbox_path=path, tenants=tenants,
+            max_inflight=max_inflight, host=host, port=port,
+            day=day, generation=gen, seed=seed)
+        self.port = self.replica.addr[1]
+        self._arm_watch()
+        self._watch = threading.Thread(target=self._run,
+                                       name="pbox-serving-supervisor",
+                                       daemon=True)
+        self._watch.start()
+
+    @property
+    def addr(self):
+        return (self.host, self.port)
+
+    def _resolve_dump(self):
+        """(path, day, generation) of the dump to load NOW — the swap
+        manifest when one is published, else the pinned --serve_xbox."""
+        if self.manifest_root:
+            from paddlebox_tpu.io.checkpoint import read_xbox_manifest
+            man = read_xbox_manifest(self.manifest_root)
+            if man:
+                return (man["path"], str(man.get("day", "")),
+                        int(man["generation"]))
+        return self.xbox_path, "", 1
+
+    def _arm_watch(self) -> None:
+        if self.manifest_root and self.watch_s > 0:
+            self.replica.watch_manifest(self.manifest_root, self.watch_s)
+
+    def _restart(self) -> bool:
+        from paddlebox_tpu.utils.backoff import Backoff
+        from paddlebox_tpu.utils.monitor import stat_add
+        old = self.replica
+        self.restarts += 1
+        flight.record("resume_begin", role="serving_replica",
+                      restart=self.restarts, port=self.port)
+        dedup = old.dedup_state()
+        path, day, gen = self._resolve_dump()
+        bo = Backoff(base=self._backoff[0], cap=self._backoff[1],
+                     deadline=30.0)
+        attempt = 0
+        while not self._stop.is_set():
+            try:
+                self.replica = self._make(
+                    config=self.config, xbox_path=path,
+                    tenants=self.tenants, max_inflight=self.max_inflight,
+                    host=self.host, port=self.port, day=day,
+                    generation=gen, seed=self.seed, dedup_state=dedup)
+                break
+            except OSError:
+                attempt += 1
+                if not bo.sleep(attempt):
+                    return False
+        else:
+            return False
+        self._arm_watch()
+        stat_add("serving.supervisor.restarts")
+        flight.record("resume_ok", role="serving_replica",
+                      restart=self.restarts, port=self.port)
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self.replica._dead:
+                if self.restarts >= self.max_restarts:
+                    flight.record("supervisor_give_up",
+                                  role="serving_replica",
+                                  restarts=self.restarts)
+                    return
+                if not self._restart():
+                    return
+            self._stop.wait(self._poll_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._watch.join(timeout=30.0)
+        self.replica.shutdown()
+
+
+def serve_fleet(args) -> int:
+    """--serve N: run N supervised serving replicas in this process and
+    block until interrupted.  Prints the replica addresses (one per
+    line, ``host:port``) so a router — ``ServingRouter([...])`` or an
+    external LB — can be pointed at the fleet."""
+    from paddlebox_tpu.config import EmbeddingTableConfig
+    from paddlebox_tpu.ps.serving import ServingRouter
+    tenants = [t.strip() for t in (args.serve_tenants or "default"
+                                   ).split(",") if t.strip()]
+    config = EmbeddingTableConfig(embedding_dim=args.serve_mf_dim)
+    sups = [ServingReplicaSupervisor(
+        config=config,
+        xbox_path=args.serve_xbox or None,
+        manifest_root=args.serve_manifest or None,
+        tenants=tenants,
+        max_inflight=args.serve_max_inflight,
+        watch_s=args.serve_watch_s,
+        seed=args.serve_seed,
+        max_restarts=args.max_restarts or 8)
+        for _ in range(args.serve)]
+    for s in sups:
+        print(f"[serve] replica {s.addr[0]}:{s.addr[1]} "
+              f"tenants={','.join(tenants)}", file=sys.stderr)
+    router = ServingRouter([s.addr for s in sups], tenant=tenants[0])
+    try:
+        while True:
+            time.sleep(5.0)
+            router.observe_generation()    # fleet-wide swap coherence
+            gens = router.generations()
+            if len(gens) > 1:
+                print(f"[serve] hot-swap in flight: generations {gens}",
+                      file=sys.stderr)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.close()
+        for s in sups:
+            s.stop()
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(prog="paddlebox_tpu.launch")
     ap.add_argument("--nproc_per_node", type=int, default=1)
@@ -679,9 +833,38 @@ def main():
                     help="evaluate the SLO rule set on every timeline "
                          "sample (FLAGS_obs_slo_watchdog; breaches emit "
                          "latched slo_breach flight events).  1 = on")
-    ap.add_argument("script")
+    ap.add_argument("--serve", type=int, default=0,
+                    help="run N supervised read-only serving replicas "
+                         "(ps/serving.py) instead of training workers; "
+                         "needs --serve_xbox or --serve_manifest")
+    ap.add_argument("--serve_xbox", default="",
+                    help="xbox dump to serve (pinned; no hot-swap unless "
+                         "--serve_manifest is also given)")
+    ap.add_argument("--serve_manifest", default="",
+                    help="directory holding XBOX_MANIFEST.json; replicas "
+                         "load the manifest's dump and hot-swap when the "
+                         "trainer publishes the next day")
+    ap.add_argument("--serve_tenants", default="default",
+                    help="comma-separated tenant namespaces "
+                         "(FLAGS_serve_tenants)")
+    ap.add_argument("--serve_max_inflight", type=int, default=None,
+                    help="per-tenant admission cap; excess pulls are shed "
+                         "with a typed overload error "
+                         "(FLAGS_serve_max_inflight)")
+    ap.add_argument("--serve_watch_s", type=float, default=2.0,
+                    help="manifest poll cadence for hot-swap (0 = never "
+                         "poll; swaps only via the swap verb)")
+    ap.add_argument("--serve_mf_dim", type=int, default=8,
+                    help="table embedding_dim — must match the trainer "
+                         "that wrote the dump")
+    ap.add_argument("--serve_seed", type=int, default=0,
+                    help="default-row seed — must match the trainer for "
+                         "bit-identical miss rows")
+    ap.add_argument("script", nargs="?", default="")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
+    if not args.serve and not args.script:
+        ap.error("script is required unless --serve is given")
     # EXPORTS for the worker processes — set_flags() cannot cross the
     # process boundary, the child's flag registry reads FLAGS_* at import
     if args.ps_streams is not None:
@@ -735,6 +918,17 @@ def main():
     if args.ckpt_dir:
         # pboxlint: disable-next=PB203 -- env export to spawned workers
         os.environ["FLAGS_ckpt_dir"] = args.ckpt_dir
+    if args.serve:
+        if args.serve_tenants:
+            # pboxlint: disable-next=PB203 -- env export to spawned workers
+            os.environ["FLAGS_serve_tenants"] = args.serve_tenants
+        if args.serve_max_inflight is not None:
+            # pboxlint: disable-next=PB203 -- env export to spawned workers
+            os.environ["FLAGS_serve_max_inflight"] = str(
+                args.serve_max_inflight)
+        if not (args.serve_xbox or args.serve_manifest):
+            ap.error("--serve needs --serve_xbox or --serve_manifest")
+        sys.exit(serve_fleet(args))
     proxy = None
     if args.chaos_backend:
         from paddlebox_tpu.ps.faults import ChaosProxy, FaultPlan

@@ -290,6 +290,20 @@ class ShardedHostTable:
         workpool.table_pool().map(pull_shard, self._shard_sel(keys))
         return out
 
+    def export_keys(self) -> np.ndarray:
+        """Every resident key, one per-shard copy under that shard's lock
+        (the serving tier freezes a loaded table from this + bulk_pull;
+        order is shard-major — callers needing an order sort)."""
+        def keys_shard(shard) -> np.ndarray:
+            with shard.lock:
+                return np.array(shard.keys, copy=True)
+
+        parts = workpool.table_pool().map(keys_shard, self._shards)
+        parts = [p for p in parts if len(p)]
+        if not parts:
+            return np.zeros((0,), np.uint64)
+        return np.concatenate(parts).astype(np.uint64, copy=False)
+
     def bulk_write(self, keys: np.ndarray, soa: Dict[str, np.ndarray]) -> None:
         def write_shard(group):
             s, sel = group

@@ -276,7 +276,8 @@ class _DedupWindow:
 # streams), never a dedup-window entry: they are idempotent, and caching
 # e.g. a bulk pull response would blow the window's bounded memory
 _RID_ECHO_ONLY = frozenset({"pull_sparse", "pull_dense", "size",
-                            "list_tables", "health", "save", "load"})
+                            "list_tables", "health", "save", "load",
+                            "forward"})
 
 # dedup-window snapshot rides in the checkpointed sparse dir, next to the
 # shard files it must stay consistent with
@@ -371,6 +372,10 @@ class PSServer:
         # race from a fault hook thread); _inflight_cv counts verbs being
         # executed so a graceful drain can wait them out
         self._life_lock = lockdep.lock("ps.service.PSServer._life_lock")
+        # role tag surfaced by the health verb: "train" for the mutable
+        # PS tier; the read-only serving tier (ps/serving.py) overrides
+        # to "serving" so scrapers/routers can tell replicas apart
+        self.mode = "train"
         self._dead = False
         self._draining = False
         self._inflight = 0
@@ -665,7 +670,8 @@ class PSServer:
             # percentiles included) even with FLAGS_obs_port off
             with self._inflight_cv:
                 inflight = self._inflight
-            return {"ok": True, "draining": self._draining,
+            return {"ok": True, "mode": self.mode,
+                    "draining": self._draining,
                     "inflight": inflight,
                     "tables": ",".join(sorted(self.tables)),
                     "stats": {k: float(v)
@@ -1500,6 +1506,30 @@ class PSClient:
     def list_tables(self) -> Dict[str, int]:
         return self._call({"cmd": "list_tables"})["tables"]
 
+    def forward(self, keys: np.ndarray, lod: np.ndarray,
+                table: Optional[str] = None) -> np.ndarray:
+        """Serving-tier ragged inference pool (ps/serving.py): per-sample
+        sum over [embed_w | mf] of each sample's keys, ``lod`` = n+1
+        offsets into ``keys``.  Single-frame (serving batches are small
+        by construction; the admission cap bounds them server-side)."""
+        resp = self._call({"cmd": "forward",
+                           "keys": np.asarray(keys, np.uint64),
+                           "lod": np.asarray(lod, np.int64),
+                           "table": table})
+        return resp["pooled"]
+
+    def invalidate_row_width(self, table: Optional[str] = None) -> None:
+        """Drop learned row-width estimates (one table, or all when
+        ``table`` is None).  Coherence point for anything that replaces
+        table CONTENTS out from under this client — load_xbox, a serving
+        hot-swap — where a stale estimate from the old rows would
+        mis-chunk the first pull against the new schema."""
+        with self._lock:
+            if table is None:
+                self._row_bytes_est.clear()
+            else:
+                self._row_bytes_est.pop(table, None)
+
     def health(self, timeout: float = 5.0) -> Dict:
         """Heartbeat: liveness + drain state, cheap enough to poll.  The
         report carries this client's wire-pool shape alongside the
@@ -1586,6 +1616,12 @@ class RemoteTableAdapter:
         exactly."""
         eff, self._write_effect = self._write_effect, None
         return eff
+
+    def invalidate_row_width(self) -> None:
+        """Forward the coherence-point invalidation to the wire client
+        (load_xbox calls this through engine.table when the engine runs
+        against a remote PS)."""
+        self.client.invalidate_row_width(self.table)
 
     def bulk_pull(self, keys):
         rows = self.client.pull_sparse(keys, table=self.table,

@@ -1,0 +1,611 @@
+"""Online serving tier — read-only xbox replicas, atomic day hot-swap,
+multi-tenant inference traffic (ROADMAP item 3: the BoxPS loop's third
+leg, train → dump → **serve**).
+
+The reference feeds a serving fleet from the xbox base/delta dumps
+(box_wrapper.cc:1286 SaveBase/SaveDelta); this module is the consumer
+side.  Three pieces:
+
+* :class:`FrozenHostTable` — an immutable snapshot of a
+  ``ShardedHostTable``: keys sorted once at load, SoA row arrays frozen,
+  lookups are pure numpy ``searchsorted`` gathers.  **No shard locks on
+  the read path** (lint rule PB701 proves no table-mutating verb, shard
+  lock, or optimizer call is reachable from it); misses serve the same
+  key-deterministic defaults training would (``fv.default_rows_keyed``),
+  so replica responses are bit-identical to an engine-side pull.
+
+* :class:`ServingReplica` — a :class:`~paddlebox_tpu.ps.service.PSServer`
+  whose verb switch is replaced with a read-only serving surface over
+  the same wire protocol (so ``PSClient``'s multi-stream pipelining,
+  rids, and quantized payloads all apply unchanged): batched
+  ``pull_sparse``, a ragged ``forward`` (per-sample sum-pool over
+  [embed_w | mf] — the gather+pool inference kernel shape), ``size`` /
+  ``list_tables`` / extended ``health``, and a ``swap`` control verb.
+  Tables are namespaced ``<tenant>/<table>`` (≙ PSCORE's table
+  hierarchy); per-tenant admission control bounds in-flight queries and
+  sheds with a typed overload error (:data:`OVERLOADED` marker, so the
+  router can tell shed from death); per-tenant
+  ``serving.<tenant>.{qps,latency_s→p50/p99,inflight,shed}`` flow
+  through the obs stack (/statz, timeline sampler, SLO watchdog).
+
+  **Hot swap**: ``hot_swap(path)`` loads the next day's dump into a
+  fresh generation off the serving path, flips one reference (a single
+  attribute store — readers that already entered the old generation
+  finish on its frozen tables), invalidates the attached DeviceRowCache
+  at the flip, then retires the old generation after its in-flight
+  queries drain.  The dump itself arrives via save_xbox's tmp+rename,
+  and the day pointer via the xbox swap manifest
+  (io/checkpoint.publish_xbox_manifest) — tmp+rename end to end; a
+  replica watching the manifest (``watch_manifest``) swaps on a
+  generation advance.
+
+* :class:`ServingRouter` — client-side fan-over: one ``PSClient`` per
+  replica, primary-first with failover on replica death
+  (``pull_sparse``/``forward`` are rid-echo idempotent verbs, and
+  replicas loaded from one dump answer bit-identically, so a retry on
+  the survivor is safe and exact).  A typed :class:`ServingOverload`
+  surfaces shed instead of blind retry; ``observe_generation`` clears
+  every client's learned row-width estimates when the fleet's
+  generation advances (the client side of the hot-swap coherence
+  point).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu import flags
+from paddlebox_tpu.config import EmbeddingTableConfig
+from paddlebox_tpu.ps import feature_value as fv
+from paddlebox_tpu.ps import wire
+from paddlebox_tpu.ps.host_table import ShardedHostTable
+from paddlebox_tpu.ps.service import DEFAULT_TABLE, PSClient, PSServer
+from paddlebox_tpu.utils import flight, lockdep
+from paddlebox_tpu.utils.monitor import (stat_add, stat_observe, stat_set,
+                                         stat_snapshot)
+
+flags.define_flag(
+    "serve_max_inflight", 64,
+    "per-tenant admission cap on a ServingReplica: queries in flight for "
+    "one tenant beyond this shed with a typed overload error instead of "
+    "queueing (0 = unbounded)")
+flags.define_flag(
+    "serve_tenants", "default",
+    "comma-separated tenant namespaces a ServingReplica serves; each "
+    "tenant sees the loaded tables as <tenant>/<table> and gets its own "
+    "admission budget + serving.<tenant>.* metrics")
+flags.define_flag(
+    "serve_drain_s", 30.0,
+    "hot-swap drain budget: seconds to wait for the old generation's "
+    "in-flight queries before retiring it (the flip itself is atomic "
+    "and never waits)")
+
+# marker embedded in the shed error string: it survives the wire and the
+# client's RuntimeError re-raise, so a router can type the failure
+# without a schema change to the error path
+OVERLOADED = "serving_overloaded"
+
+_METERED_VERBS = frozenset({"pull_sparse", "forward"})
+_READ_VERBS = frozenset({"pull_sparse", "forward", "size", "list_tables"})
+
+
+class ServingOverload(RuntimeError):
+    """Per-tenant admission shed — the replica is alive but this tenant
+    is at its in-flight cap.  Deliberately NOT a ConnectionError: a shed
+    must not trigger failover/retry storms against the next replica."""
+
+
+class FrozenHostTable:
+    """Immutable lookup-only snapshot of one embedding table.
+
+    Built once at load (sort by key, copy the SoA into contiguous
+    arrays); after that every ``lookup_rows`` is a pure numpy gather —
+    no locks, no growth, no mutation surface at all.  Swaps replace the
+    whole object by one reference flip.  Misses get the identical
+    key-deterministic defaults a training-side ``bulk_pull`` would
+    (``fv.default_rows_keyed`` with the same config + seed), which is
+    what makes replica responses bit-identical to the engine."""
+
+    def __init__(self, config: EmbeddingTableConfig, keys: np.ndarray,
+                 soa: Dict[str, np.ndarray], seed: int = 0):
+        self.config = config
+        self.mf_dim = config.embedding_dim
+        self.expand_dim = config.expand_dim
+        self.adam = config.sgd.optimizer in ("adam", "shared_adam")
+        self.optimizer = config.sgd.optimizer
+        self.double_stats = config.accessor.accessor_type == "ctr_double"
+        self._seed = seed
+        keys = np.asarray(keys, np.uint64)
+        order = np.argsort(keys, kind="stable")
+        self._keys = np.ascontiguousarray(keys[order])
+        self._soa = {f: np.ascontiguousarray(a[order])
+                     for f, a in soa.items()}
+
+    @classmethod
+    def freeze(cls, table: ShardedHostTable) -> "FrozenHostTable":
+        """Snapshot a live ShardedHostTable (load/control path — this
+        DOES take the shard locks once; the resulting object never
+        does)."""
+        keys = table.export_keys()
+        soa = table.bulk_pull(keys)
+        return cls(table.config, keys, soa, seed=table._seed)
+
+    def size(self) -> int:
+        return int(len(self._keys))
+
+    def lookup_rows(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
+        """Rows for ``keys`` — resident rows from the frozen snapshot,
+        misses as key-deterministic defaults.  Lock-free by
+        construction: every array here is immutable after __init__."""
+        keys = np.asarray(keys, np.uint64)
+        out = fv.default_rows_keyed(keys, self.mf_dim, self._seed,
+                                    self.config.sgd.mf_initial_range,
+                                    self.config.sgd.initial_range,
+                                    self.expand_dim, self.adam,
+                                    self.config.sgd.beta1_decay_rate,
+                                    self.config.sgd.beta2_decay_rate,
+                                    self.optimizer, self.double_stats)
+        if len(self._keys) and len(keys):
+            pos = np.searchsorted(self._keys, keys)
+            pos = np.minimum(pos, len(self._keys) - 1)
+            found = self._keys[pos] == keys
+            if found.any():
+                src = pos[found]
+                for f, arr in self._soa.items():
+                    out[f][found] = arr[src]
+        return out
+
+
+class _Generation:
+    """One loaded day: the frozen table namespace plus an in-flight
+    counter so a hot swap can retire it only after the queries that
+    entered it drain (readers grab the generation BEFORE touching its
+    tables and exit in a finally)."""
+
+    def __init__(self, tables: Dict[str, FrozenHostTable],
+                 generation: int, day: str):
+        self.tables = tables
+        self.generation = int(generation)
+        self.day = day
+        self._inflight = 0
+        self._cv = lockdep.condition("ps.serving._Generation._cv")
+
+    def enter(self) -> None:
+        with self._cv:
+            self._inflight += 1
+
+    def exit(self) -> None:
+        with self._cv:
+            self._inflight -= 1
+            self._cv.notify_all()
+
+    def drain(self, timeout: float) -> bool:
+        """Wait for in-flight queries to reach zero; False on timeout
+        (the straggler still holds its table references — retirement is
+        reference-drop, never destruction, so it stays safe)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._inflight > 0:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return False
+                self._cv.wait(rem)
+        return True
+
+
+class _LoadTarget:
+    """Minimal engine shim for io.checkpoint.load_xbox: a serving-mode
+    loader writing into a scratch ShardedHostTable that is frozen and
+    dropped right after (the replica never exposes the mutable table)."""
+
+    def __init__(self, config: EmbeddingTableConfig, seed: int):
+        self.mode = "serving"
+        self.config = config
+        self.table = ShardedHostTable(config, seed=seed)
+        self.cache = None
+
+
+class ServingReplica(PSServer):
+    """Read-only PSServer serving frozen xbox generations (docstring at
+    module top).  Construct with the day-1 dump, then ``hot_swap`` (or
+    the ``swap`` wire verb / ``watch_manifest``) to later days."""
+
+    def __init__(self, config: Optional[EmbeddingTableConfig] = None,
+                 xbox_path: Optional[str] = None,
+                 tenants: Optional[Sequence[str]] = None,
+                 max_inflight: Optional[int] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 day: str = "", generation: int = 1,
+                 seed: int = 0, dedup_state=None):
+        self._config = config or EmbeddingTableConfig()
+        self._seed = seed
+        if tenants is None:
+            tenants = [t.strip() for t in
+                       str(flags.get_flags("serve_tenants")).split(",")
+                       if t.strip()]
+        self.tenants: List[str] = list(tenants) or ["default"]
+        self._max_inflight = int(
+            flags.get_flags("serve_max_inflight")
+            if max_inflight is None else max_inflight)
+        self._adm_lock = lockdep.lock("ps.serving.ServingReplica._adm_lock")
+        self._tenant_inflight = {t: 0 for t in self.tenants}
+        self._swap_lock = lockdep.lock("ps.serving.ServingReplica._swap_lock")
+        self._swapping = False
+        # optional DeviceRowCache hook: a co-resident forward model's row
+        # cache registered here is invalidated at every swap point
+        self.cache = None
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        gen0 = self._build_generation(xbox_path, day, int(generation))
+        self._gen = gen0
+        super().__init__(gen0.tables, host=host, port=port,
+                         dedup_state=dedup_state)
+        self.mode = "serving"
+
+    # -- generation load / swap ----------------------------------------------
+    def _build_generation(self, xbox_path: Optional[str], day: str,
+                          generation: int) -> _Generation:
+        t0 = time.monotonic()
+        if xbox_path:
+            from paddlebox_tpu.io.checkpoint import load_xbox
+            shim = _LoadTarget(self._config, self._seed)
+            load_xbox(shim, xbox_path)
+            frozen = FrozenHostTable.freeze(shim.table)
+        else:
+            frozen = FrozenHostTable.freeze(
+                ShardedHostTable(self._config, seed=self._seed))
+        tables: Dict[str, FrozenHostTable] = {DEFAULT_TABLE: frozen}
+        for t in self.tenants:
+            tables[f"{t}/{DEFAULT_TABLE}"] = frozen
+        g = _Generation(tables, generation, day)
+        stat_set("serving.generation", float(generation))
+        stat_observe("serving.load_s", time.monotonic() - t0)
+        flight.record("serving_load", generation=generation, day=day,
+                      rows=frozen.size(), source=xbox_path or "<empty>")
+        return g
+
+    def hot_swap(self, xbox_path: str, day: str = "",
+                 generation: Optional[int] = None,
+                 drain_timeout: Optional[float] = None) -> int:
+        """Load ``xbox_path`` as the next generation, flip atomically,
+        retire the old generation after its in-flight queries drain.
+        Serialized against concurrent swaps; the flip never blocks the
+        serving path (readers see either generation whole)."""
+        with self._swap_lock:
+            if self._swapping:
+                raise RuntimeError("hot_swap already in progress")
+            self._swapping = True
+        try:
+            cur = self._gen
+            gen_no = (cur.generation + 1 if generation is None
+                      else int(generation))
+            new = self._build_generation(xbox_path, day, gen_no)
+            with self._swap_lock:
+                old = self._gen
+                # THE swap: one reference store.  A reader that already
+                # did `g = self._gen; g.enter()` finishes on `old`'s
+                # frozen tables; every later reader sees `new`.
+                self._gen = new
+                self.tables = dict(new.tables)
+            cache = self.cache
+            if cache is not None:
+                # coherence point: any device-resident rows mirror the
+                # RETIRED generation now
+                cache.invalidate("serving_swap")
+        finally:
+            with self._swap_lock:
+                self._swapping = False
+        budget = float(flags.get_flags("serve_drain_s")
+                       if drain_timeout is None else drain_timeout)
+        drained = old.drain(budget)
+        stat_add("serving.swap")
+        if not drained:
+            stat_add("serving.swap_drain_timeout")
+        flight.record("serving_swap", generation=gen_no, day=day,
+                      prev_generation=old.generation, drained=drained)
+        return gen_no
+
+    def watch_manifest(self, root: str, poll_s: float = 2.0) -> None:
+        """Poll the xbox swap manifest under ``root`` and hot-swap when
+        its generation advances past the loaded one (the replica side of
+        the train→publish→serve day loop)."""
+        from paddlebox_tpu.io.checkpoint import read_xbox_manifest
+
+        def run() -> None:
+            while not self._watch_stop.wait(poll_s):
+                try:
+                    man = read_xbox_manifest(root)
+                    if man and int(man["generation"]) > self._gen.generation:
+                        self.hot_swap(man["path"],
+                                      day=str(man.get("day", "")),
+                                      generation=int(man["generation"]))
+                except Exception:  # noqa: BLE001 — the watcher must outlive a bad day
+                    stat_add("serving.watch_errors")
+
+        # pboxlint: disable-next=PB405 -- joined in shutdown() via _watch_stop
+        self._watch_thread = threading.Thread(
+            target=run, name="pbox-serving-watch", daemon=True)
+        self._watch_thread.start()
+
+    def shutdown(self, drain_timeout: float = 5.0) -> None:
+        self._watch_stop.set()
+        t = self._watch_thread
+        if t is not None:
+            t.join(timeout=5.0)
+        super().shutdown(drain_timeout)
+
+    def kill(self) -> None:
+        self._watch_stop.set()      # abrupt death: no join, but no swaps
+        super().kill()
+
+    # -- verb surface ---------------------------------------------------------
+    def _exec_verb(self, req: Dict) -> Dict:
+        cmd = req["cmd"]
+        if cmd == "health":
+            return self._health_verb()
+        if cmd == "swap":
+            return self._swap_verb(req)
+        if cmd in _READ_VERBS:
+            return self._serve_read(req)
+        return {"ok": False, "readonly": True,
+                "error": f"serving replica: verb {cmd!r} not available "
+                         f"on the read-only tier (reads: "
+                         f"{sorted(_READ_VERBS)}, control: health/swap)"}
+
+    def _swap_verb(self, req: Dict) -> Dict:
+        gen = self.hot_swap(req["path"], day=str(req.get("day", "")),
+                            generation=req.get("generation"),
+                            drain_timeout=req.get("drain_timeout"))
+        return {"ok": True, "generation": gen}
+
+    def _health_verb(self) -> Dict:
+        with self._inflight_cv:
+            inflight = self._inflight
+        g = self._gen
+        with self._adm_lock:
+            per_tenant = dict(self._tenant_inflight)
+        return {"ok": True, "mode": "serving", "draining": self._draining,
+                "inflight": inflight,
+                "generation": g.generation, "day": g.day,
+                "tenants": ",".join(self.tenants),
+                "tenant_inflight": per_tenant,
+                "tables": ",".join(sorted(g.tables)),
+                "stats": {k: float(v)
+                          for k, v in stat_snapshot("serving.").items()}}
+
+    def _serve_read(self, req: Dict) -> Dict:
+        """THE serving read path — lint rule PB701 proves no
+        table-mutating verb, shard-lock acquisition, or optimizer call
+        is transitively reachable from here."""
+        cmd = req["cmd"]
+        name = req.get("table") or DEFAULT_TABLE
+        tenant = name.split("/", 1)[0] if "/" in name else "default"
+        metered = cmd in _METERED_VERBS
+        cap = self._max_inflight
+        with self._adm_lock:
+            cur = self._tenant_inflight.get(tenant)
+            if cur is None:
+                return {"ok": False,
+                        "error": f"unknown tenant {tenant!r} (serving "
+                                 f"{sorted(self._tenant_inflight)})"}
+            if metered and cap > 0 and cur >= cap:
+                stat_add(f"serving.{tenant}.shed")
+                return {"ok": False, "shed": True, "tenant": tenant,
+                        "error": f"{OVERLOADED}: tenant {tenant!r} at "
+                                 f"max inflight {cap}"}
+            self._tenant_inflight[tenant] = cur + 1
+        stat_set(f"serving.{tenant}.inflight", float(cur + 1))
+        g = self._gen
+        g.enter()
+        t0 = time.monotonic()
+        try:
+            tab = g.tables.get(name)
+            if tab is None:
+                return {"ok": False,
+                        "error": f"unknown table {name!r} "
+                                 f"(have {sorted(g.tables)})"}
+            if cmd == "size":
+                return {"ok": True, "size": tab.size(),
+                        "generation": g.generation}
+            if cmd == "list_tables":
+                return {"ok": True, "generation": g.generation,
+                        "tables": {n: t.size()
+                                   for n, t in g.tables.items()}}
+            if cmd == "forward":
+                pooled = self._forward(tab, req["keys"], req["lod"])
+                return {"ok": True, "pooled": pooled,
+                        "generation": g.generation}
+            rows = tab.lookup_rows(req["keys"])
+            wd = req.get("wire_dtype")
+            if wd and wd != "f32":
+                rows = wire.quantize_rows(rows, wd, verb="pull_sparse")
+            return {"ok": True, "rows": rows, "generation": g.generation}
+        finally:
+            g.exit()
+            if metered:
+                stat_add(f"serving.{tenant}.qps")
+                stat_observe(f"serving.{tenant}.latency_s",
+                             time.monotonic() - t0)
+            with self._adm_lock:
+                self._tenant_inflight[tenant] -= 1
+                left = self._tenant_inflight[tenant]
+            stat_set(f"serving.{tenant}.inflight", float(left))
+
+    @staticmethod
+    def _forward(tab: FrozenHostTable, keys: np.ndarray,
+                 lod: np.ndarray) -> np.ndarray:
+        """Ragged inference pool: per-sample sum over [embed_w | mf] of
+        that sample's keys (``lod`` = n+1 offsets into ``keys``) — the
+        batched gather+pool kernel shape of sparse-CTR serving.  Exact
+        segment sums via prefix differences (reduceat mishandles empty
+        segments)."""
+        rows = tab.lookup_rows(keys)
+        emb = np.concatenate([rows["embed_w"][:, None], rows["mf"]], axis=1)
+        lod = np.asarray(lod, np.int64)
+        csum = np.concatenate(
+            [np.zeros((1, emb.shape[1]), np.float64),
+             np.cumsum(emb.astype(np.float64), axis=0)], axis=0)
+        return (csum[lod[1:]] - csum[lod[:-1]]).astype(np.float32)
+
+
+class ServingRouter:
+    """Client-side fan-over across serving replicas: primary-first with
+    failover on replica death.  ``pull_sparse``/``forward`` are rid-echo
+    idempotent verbs and every replica of one generation answers
+    bit-identically, so a failover retry cannot duplicate or corrupt a
+    query — exactly one response per query, byte-equal to a
+    single-replica run.  Shed (:data:`OVERLOADED` in the error) raises
+    the typed :class:`ServingOverload` instead of failing over: the
+    fleet is alive, the tenant is just over budget."""
+
+    def __init__(self, addrs: Sequence[Tuple[str, int]],
+                 tenant: str = "default", **client_kwargs):
+        client_kwargs.setdefault("retries", 1)
+        client_kwargs.setdefault("deadline", 10.0)
+        self.tenant = tenant
+        self._client_kwargs = dict(client_kwargs)
+        self._clients = [PSClient(tuple(a), **client_kwargs)
+                         for a in addrs]
+        self._dead = [False] * len(self._clients)
+        self._lock = lockdep.lock("ps.serving.ServingRouter._lock")
+        self._primary = 0
+        self._last_generation: Optional[int] = None
+
+    def _order(self) -> List[Tuple[int, PSClient]]:
+        with self._lock:
+            idxs = list(range(len(self._clients)))
+            order = idxs[self._primary:] + idxs[:self._primary]
+            return [(i, self._clients[i]) for i in order
+                    if not self._dead[i]]
+
+    def _mark_dead(self, idx: int) -> None:
+        with self._lock:
+            self._dead[idx] = True
+            live = [i for i in range(len(self._clients))
+                    if not self._dead[i]]
+            if live:
+                self._primary = live[0]
+
+    def _qualify(self, table: Optional[str]) -> str:
+        name = table or DEFAULT_TABLE
+        return name if "/" in name else f"{self.tenant}/{name}"
+
+    def _resurrect(self) -> bool:
+        """Second-chance probe when the live set is empty: a supervisor
+        (launch.ServingReplicaSupervisor) restarts a dead replica IN
+        PLACE on the same port, so a dead address can come back.  Each
+        dead slot gets a fresh client (the old one's sockets died with
+        the peer) and a health probe; responders rejoin the rotation."""
+        with self._lock:
+            dead = [(i, self._clients[i].addr)
+                    for i, d in enumerate(self._dead) if d]
+        revived = False
+        for i, addr in dead:
+            probe = PSClient(addr, **self._client_kwargs)
+            try:
+                probe.health(timeout=2.0)
+            except (ConnectionError, RuntimeError, OSError):
+                probe.close()
+                continue
+            with self._lock:
+                self._clients[i].close()
+                self._clients[i] = probe
+                self._dead[i] = False
+            stat_add("serving.router.resurrect")
+            flight.record("serving_resurrect", replica=i)
+            revived = True
+        return revived
+
+    def _fan(self, call, verb: str):
+        errs: List[str] = []
+        for attempt in range(2):
+            for i, c in self._order():
+                try:
+                    return call(c)
+                except ConnectionError as e:
+                    self._mark_dead(i)
+                    stat_add("serving.router.failover")
+                    flight.record("serving_failover", replica=i,
+                                  verb=verb, error=type(e).__name__)
+                    errs.append(f"replica[{i}]: {e}")
+                    continue
+                except RuntimeError as e:
+                    if OVERLOADED in str(e):
+                        stat_add("serving.router.shed")
+                        raise ServingOverload(str(e)) from e
+                    raise
+            if attempt == 0 and not self._resurrect():
+                break
+        raise ConnectionError(
+            f"all serving replicas failed for {verb!r}: "
+            + ("; ".join(errs) or "none alive"))
+
+    # -- verbs ---------------------------------------------------------------
+    def pull_sparse(self, keys: np.ndarray,
+                    table: Optional[str] = None) -> Dict[str, np.ndarray]:
+        full = self._qualify(table)
+        return self._fan(lambda c: c.pull_sparse(keys, table=full),
+                         "pull_sparse")
+
+    def forward(self, keys: np.ndarray, lod: np.ndarray,
+                table: Optional[str] = None) -> np.ndarray:
+        full = self._qualify(table)
+        return self._fan(lambda c: c.forward(keys, lod, table=full),
+                         "forward")
+
+    def health(self) -> List[Optional[Dict]]:
+        """Per-replica health (None for dead/unreachable replicas) —
+        mixed ``generation`` values across live replicas expose a
+        half-finished fleet hot-swap."""
+        with self._lock:
+            any_dead = any(self._dead)
+        if any_dead:
+            self._resurrect()
+        out: List[Optional[Dict]] = []
+        for i, c in enumerate(self._clients):
+            with self._lock:
+                dead = self._dead[i]
+            if dead:
+                out.append(None)
+                continue
+            try:
+                out.append(c.health(timeout=2.0))
+            except (ConnectionError, RuntimeError, OSError):
+                self._mark_dead(i)
+                out.append(None)
+        return out
+
+    def generations(self) -> List[int]:
+        """Distinct loaded generations across live replicas (len > 1 ⇒
+        a hot-swap is in flight somewhere)."""
+        gens = {int(h["generation"]) for h in self.health()
+                if h and "generation" in h}
+        return sorted(gens)
+
+    def observe_generation(self) -> bool:
+        """Client-side hot-swap coherence point: when the fleet's max
+        generation advances past the last one seen, drop every client's
+        learned row-width estimates (a new day's dump may change row
+        widths; a stale estimate would mis-chunk the first pull).
+        Returns True when an advance was observed."""
+        gens = self.generations()
+        if not gens:
+            return False
+        head = gens[-1]
+        with self._lock:
+            last = self._last_generation
+            self._last_generation = head
+        if last is not None and head > last:
+            for c in self._clients:
+                c.invalidate_row_width()
+            stat_add("serving.router.gen_advance")
+            return True
+        return False
+
+    def close(self) -> None:
+        for c in self._clients:
+            c.close()

@@ -138,11 +138,11 @@ def ALL_CHECKERS():
                                               flags_hygiene, flight_events,
                                               lifecycle, lockgraph, locks,
                                               metric_names, purity, retries,
-                                              slo_rules)
+                                              serving_path, slo_rules)
     return (locks.check, flags_hygiene.check, metric_names.check,
             flight_events.check, purity.check, lifecycle.check,
             retries.check, atomic_io.check, device_cache.check,
-            lockgraph.check, slo_rules.check)
+            lockgraph.check, slo_rules.check, serving_path.check)
 
 
 def lint_modules(modules: Sequence[Module]) -> List[Finding]:

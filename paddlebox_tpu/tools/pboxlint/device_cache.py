@@ -18,7 +18,8 @@
          ``<something>cache<...>.invalidate(...)`` outside a function
          whose name mentions a recognized coherence point (invalidate /
          reset / resume / rollback / restore / set_date / end_day /
-         shrink / load / close / abort / freeze / restart / teardown).
+         shrink / load / close / abort / freeze / restart / teardown /
+         swap — the serving tier's generation hot-swap).
          ``ps/device_cache.py`` itself (the implementation) and test
          files are exempt.
 """
@@ -34,7 +35,7 @@ from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
 _FOLD_HINTS = ("end_pass",)
 _INVALIDATE_HINTS = ("invalidate", "reset", "resume", "rollback", "restore",
                      "set_date", "end_day", "shrink", "load", "close",
-                     "abort", "freeze", "restart", "teardown")
+                     "abort", "freeze", "restart", "teardown", "swap")
 _EXEMPT_BASENAMES = ("device_cache.py",)
 
 

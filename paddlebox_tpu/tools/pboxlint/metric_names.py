@@ -16,9 +16,10 @@ SpanTracer cardinality discipline, utils/monitor.py / utils/trace.py).
            per-pass report and the Prometheus exporter all key on.
 
 Bounded fields are the closed vocabularies of the wire protocol: a verb
-name, a fault site/kind, a role — recognized syntactically as a name,
-attribute or const-subscript whose TERMINAL component is one of
-``cmd / verb / site / kind / role / phase / stage / table`` (e.g.
+name, a fault site/kind, a role, a configured serving tenant —
+recognized syntactically as a name, attribute or const-subscript whose
+TERMINAL component is one of
+``cmd / verb / site / kind / role / phase / stage / table / tenant`` (e.g.
 ``verb``, ``msg['cmd']``, ``hit.kind``).  Anything else — ``f"k.{key}"``,
 ``"k." + rid`` — is flagged.  A deliberately dynamic name suppresses
 with a reason, like every other rule.
@@ -36,7 +37,7 @@ from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
 _NAME_SINKS = {"stat_add", "stat_observe", "stat_max", "stat_set",
                "stat_get", "span", "start_span"}
 _BOUNDED_FIELDS = {"cmd", "verb", "site", "kind", "role", "phase",
-                   "stage", "table"}
+                   "stage", "table", "tenant"}
 _LITERAL_OK = re.compile(r"[a-z0-9_.]*\Z")
 
 

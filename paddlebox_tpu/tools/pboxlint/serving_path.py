@@ -1,0 +1,186 @@
+"""PB701 — serving read-path purity (the lock-free serving contract).
+
+The serving tier's whole guarantee (ps/serving.py) is that answering a
+query can never mutate a table, contend on a shard lock, or run
+optimizer math: tables are frozen at load, swaps are a reference flip,
+and the read path is pure gathers.  That property is structural — one
+"harmless" helper call away from silently regressing (e.g. a fallback
+that upserts a missing row, or a stats helper that reuses a locked
+training path) — so this rule proves it over the whole-package call
+graph instead of trusting review:
+
+  PB701  a table-mutating verb, a ``ps.host_table._Shard.lock``
+         acquisition, or a ``ps.optimizer.*`` call is TRANSITIVELY
+         reachable from the serving read path.
+
+Roots are the read-path entry points: ``*_serve_read`` (the replica's
+verb body) and ``lookup_rows`` (the frozen table's gather) in any
+``serving`` module.  Reachability reuses the PB6xx interprocedural
+machinery (``lockgraph.LockAnalysis`` over ``callgraph.PackageGraph``)
+including its widening cap, so PB701's view of "reachable" is exactly
+the lock analysis's.  Mutators are recognized two ways:
+
+  * by NAME for the package's distinctive mutating verbs
+    (``bulk_write`` / ``upsert`` / ``end_day`` / ``shrink`` /
+    ``filter_keep`` / ``push_sparse`` / ``push_sparse_delta`` /
+    ``push_dense`` / ``load_xbox``) — catches unresolved dynamic calls;
+    deliberately NOT generic names (``load``/``save``/``replace`` —
+    ``json.load`` and ``str.replace`` would drown the rule), those are
+    matched by full qname only.
+  * by resolved QNAME for the generic-named ones
+    (``ShardedHostTable.save/load``, ``io.checkpoint.save_xbox``) and
+    by prefix for the optimizer package.
+
+Findings anchor in the serving module: at the offending line when the
+offense is in serving code itself, else at the serving-side call site
+whose chain reaches the offense (the chain is spelled out in the
+message — the fix is almost always "don't call that from the read
+path").
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from paddlebox_tpu.tools.pboxlint import callgraph, lockgraph
+from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
+                                               PackageContext)
+
+_ROOT_NAMES = {"_serve_read", "lookup_rows"}
+_SHARD_LOCK = "ps.host_table._Shard.lock"
+_OPT_PREFIX = "ps.optimizer."
+# distinctive mutating verb names — safe to match on the bare call name
+_MUTATOR_NAMES = frozenset({
+    "bulk_write", "upsert", "end_day", "shrink", "filter_keep",
+    "push_sparse", "push_sparse_delta", "push_dense", "load_xbox",
+})
+# generic-named mutators: full resolved qname only
+_MUTATOR_QNAMES = frozenset({
+    "ps.host_table.ShardedHostTable.save",
+    "ps.host_table.ShardedHostTable.load",
+    "ps.host_table._Shard.replace",
+    "io.checkpoint.save_xbox",
+    "io.checkpoint.load_xbox",
+})
+
+
+def _is_serving_module(fn: "callgraph.FuncInfo") -> bool:
+    mod = callgraph.module_name(fn.mod.path)
+    return mod.rsplit(".", 1)[-1] == "serving"
+
+
+def _own_body_calls(fn_node) -> List[ast.Call]:
+    """Every ast.Call in the function's OWN body (nested defs excluded —
+    they are their own summaries and only matter if actually called)."""
+    out: List[ast.Call] = []
+    stack = list(fn_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _offenses(summary) -> List[Tuple[int, str]]:
+    """(line, description) of every forbidden act in ONE function body."""
+    out: List[Tuple[int, str]] = []
+    for fp, line, _held in summary.acquires:
+        if fp == _SHARD_LOCK:
+            out.append((line, f"acquires shard lock {_SHARD_LOCK}"))
+    # name-based mutator match straight off the AST: an UNRESOLVED call
+    # (untyped receiver, nothing to widen to) never becomes a CallSite,
+    # but `x.bulk_write(...)` is damning whatever x turns out to be
+    for node in _own_body_calls(summary.fn.node):
+        func = node.func
+        tail = (func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else "")
+        if tail in _MUTATOR_NAMES:
+            out.append((node.lineno,
+                        f"calls table-mutating verb {tail}()"))
+    # qname match for the generic-named mutators (resolved targets only;
+    # widened CHA guesses would fire on every same-named method)
+    for cs in summary.fn.calls:
+        if cs.kind != "call" or cs.widened \
+                or cs.name in _MUTATOR_NAMES:
+            continue
+        for t in cs.targets:
+            if t in _MUTATOR_QNAMES:
+                out.append((cs.line, f"calls table-mutating {t}"))
+                break
+            if t.startswith(_OPT_PREFIX):
+                out.append((cs.line, f"calls optimizer {t}"))
+                break
+    return out
+
+
+def _analyze(lg: "lockgraph.LockAnalysis") -> List[Finding]:
+    roots = sorted(
+        q for q, s in lg.summaries.items()
+        if _is_serving_module(s.fn)
+        and q.rsplit(".", 1)[-1] in _ROOT_NAMES)
+    if not roots:
+        return []
+    # BFS with parent edges (caller qname, serving-side call line) so a
+    # deep offense can be anchored at the serving call site it hangs off
+    parent: Dict[str, Tuple[str, int]] = {}
+    seen: Set[str] = set(roots)
+    stack = list(roots)
+    while stack:
+        q = stack.pop()
+        for cs in lg.summaries[q].fn.calls:
+            for t in lg._call_targets(cs):
+                if t in lg.summaries and t not in seen:
+                    seen.add(t)
+                    parent[t] = (q, cs.line)
+                    stack.append(t)
+
+    def anchor(q: str, line: int) -> Optional[Tuple[str, int, str]]:
+        """(serving qname, serving line, chain text) for offense in q."""
+        chain: List[str] = []
+        cur, cur_line = q, line
+        while not _is_serving_module(lg.summaries[cur].fn):
+            chain.append(cur)
+            if cur not in parent:
+                return None        # unreachable from a serving anchor
+            cur, cur_line = parent[cur]
+        chain.append(cur)
+        return cur, cur_line, " → ".join(reversed(chain))
+
+    findings: List[Finding] = []
+    emitted: Set[Tuple[str, int, str]] = set()
+    for q in sorted(seen):
+        for line, desc in _offenses(lg.summaries[q]):
+            anch = anchor(q, line)
+            if anch is None:
+                continue
+            aq, aline, chain = anch
+            key = (aq, aline, desc)
+            if key in emitted:
+                continue
+            emitted.add(key)
+            where = ("" if q == aq
+                     else f" via {chain} ({lg.summaries[q].fn.mod.path}:"
+                          f"{line})")
+            findings.append(Finding(
+                lg.summaries[aq].fn.mod.path, aline, "PB701",
+                f"serving read path {aq} {desc}{where} — the read tier "
+                f"is frozen-table + lock-free by contract; mutation, "
+                f"shard locking and optimizer math belong to the "
+                f"training tier (swap in a new generation instead)"))
+    return findings
+
+
+def check(mod: Module, ctx: PackageContext) -> List[Finding]:
+    cache = getattr(ctx, "_pb701", None)
+    if cache is None:
+        lg = getattr(ctx, "_lockgraph", None)
+        if lg is None:
+            lg = lockgraph.analyze(ctx.modules)
+            ctx._lockgraph = lg
+        cache = _analyze(lg)
+        ctx._pb701 = cache
+    return [f for f in cache if f.path == mod.path]

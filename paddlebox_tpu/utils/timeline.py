@@ -66,6 +66,11 @@ flags.define_flag(
     "obs_slo_auc_drop", 0.05,
     "SLO watchdog epsilon for the AUC-drop rule: breach when quality.auc "
     "falls more than this below its recent-window maximum")
+flags.define_flag(
+    "obs_slo_serving_p99_ms", 250.0,
+    "serving-tier SLO: per-tenant pull p99 latency budget in ms "
+    "(serving_rules breaches when serving.<tenant>.latency_s.p99 stays "
+    "over this for the rule window)")
 
 # Keys carrying level/percentile semantics: retained as value series but
 # excluded from rate derivation (a gauge moving down is not a counter
@@ -310,6 +315,27 @@ def default_rules() -> List[SloRule]:
                 window_s=600.0, min_samples=2,
                 reason="pass AUC fell below its recent-window maximum"),
     ]
+
+
+def serving_rules(tenants: Sequence[str] = ("default",)) -> List[SloRule]:
+    """Per-tenant serving-tier SLO rules (ps/serving.py's metric surface)
+    — appended to ``default_rules()`` by the serving entrypoints, one
+    p99-latency and one shed-rate rule per configured tenant.  Tenants
+    are a closed configured set, so the rule count stays bounded."""
+    p99_s = float(flags.get_flags("obs_slo_serving_p99_ms")) / 1000.0
+    out: List[SloRule] = []
+    for t in tenants:
+        out.append(SloRule(
+            f"serving_{t}_p99", f"serving.{t}.latency_s.p99",
+            kind="gauge", op="gt", threshold=p99_s,
+            window_s=30.0, min_samples=3,
+            reason=f"serving pull p99 over budget for tenant {t}"))
+        out.append(SloRule(
+            f"serving_{t}_shed", f"serving.{t}.shed",
+            kind="rate", op="gt", threshold=1.0,
+            window_s=30.0, min_samples=3,
+            reason=f"admission control sustained-shedding tenant {t}"))
+    return out
 
 
 class TimelineSampler:

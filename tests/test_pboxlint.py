@@ -1075,3 +1075,74 @@ def test_launcher_exports_and_readme_flags_are_registered():
     assert rows, "no README flag-table rows parsed"
     assert rows <= defined, \
         f"README documents unregistered flags: {sorted(rows - defined)}"
+
+
+# -- PB701: serving read-path purity -----------------------------------------
+
+def serving_codes(src, path="ps/serving.py"):
+    return codes(src, path)
+
+
+def test_pb701_direct_mutator_on_read_path():
+    src = """
+    class Rep:
+        def _serve_read(self, req):
+            self.table.bulk_write(req["keys"], req["rows"])
+    """
+    assert "PB701" in serving_codes(src)
+
+
+def test_pb701_transitive_through_helper():
+    """The offense lives in a helper — the finding anchors at the
+    serving-side call chain, proving reachability, not just grep."""
+    src = """
+    class Rep:
+        def _serve_read(self, req):
+            return self._fallback(req)
+
+        def _fallback(self, req):
+            self.table.upsert(req["keys"], req["rows"])
+    """
+    assert "PB701" in serving_codes(src)
+
+
+def test_pb701_shard_lock_from_lookup():
+    """lookup_rows is a read-path root: acquiring the host-table shard
+    lock from it breaks the lock-free serving contract."""
+    src = """
+    from paddlebox_tpu.utils import lockdep
+
+    class Tab:
+        def __init__(self):
+            self.lk = lockdep.lock("ps.host_table._Shard.lock")
+
+        def lookup_rows(self, keys):
+            with self.lk:
+                return keys
+    """
+    assert "PB701" in serving_codes(src)
+
+
+def test_pb701_clean_read_path_silent():
+    src = """
+    class Tab:
+        def lookup_rows(self, keys):
+            return {"embed_w": keys}
+
+    class Rep:
+        def _serve_read(self, req):
+            t = Tab()
+            return t.lookup_rows(req["keys"])
+    """
+    assert serving_codes(src) == []
+
+
+def test_pb701_non_serving_module_out_of_scope():
+    """The same mutating code outside a serving module is the training
+    tier doing its job — not a PB701."""
+    src = """
+    class Rep:
+        def _serve_read(self, req):
+            self.table.bulk_write(req["keys"], req["rows"])
+    """
+    assert "PB701" not in serving_codes(src, path="ps/other.py")
