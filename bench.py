@@ -57,6 +57,8 @@ BENCH_CACHE=0 (skip the device-cache on/off compare),
 BENCH_CACHE_PASSES/_KEYS/_DRAWS/_ROWS (cache-compare geometry),
 BENCH_SERVING=0 (skip the serving-tier QPS/p99 phase),
 BENCH_SERVING_KEYS/_BATCHES/_BATCH (serving-phase geometry),
+BENCH_CLUSTER=0 (skip the sharded-PS N=1 vs N=4 phase),
+BENCH_CLUSTER_KEYS/_ROUNDS/_BATCH/_SHARDS/_REPS (cluster-phase geometry),
 BENCH_TIMELINE_S (telemetry-timeline sampler cadence, default 1.0;
 0 disables — the run's `timeline` summary then stays empty).
 """
@@ -692,6 +694,163 @@ def _serving_bench(tag):
         _shutil.rmtree(root, ignore_errors=True)
 
 
+def _cluster_bench(tag):
+    """Sharded-PS phase: aggregate pull+push wire throughput of ONE
+    sharded client against N=1 vs N=4 live PS server PROCESSES (real
+    sockets, one interpreter per shard — the production fleet shape;
+    in-process servers would serialize all table work on this
+    interpreter's lock and measure nothing) over IDENTICAL zipf key
+    blocks — the ROADMAP item 1 scale-out claim on the CPU basis.
+
+    Fleet throughput is defined by the CRITICAL PATH: with shards on
+    independent hosts/cores, a fanned-out verb completes when the
+    slowest shard finishes its partition, so aggregate wire throughput
+    is total keys / Σ_rounds max_shard(service time), with each shard's
+    service time measured uncontended (this bench host may have fewer
+    cores than shards — concurrent wall clock there measures core
+    contention, not wire capacity, and is reported separately as
+    n4.wall_s alongside slowest_shard_stall_s from the live fan-out).
+    wire_speedup = t(N=1) / t(N=4 critical path).
+
+    Both sides of that ratio are min-of-k per-round times (k =
+    BENCH_CLUSTER_REPS): service time is a property of the work, so any
+    slower repeat is interference (this process keeps the timeline
+    sampler + obs stack running through every phase), and the per-round
+    max-over-shards estimator would otherwise amplify a single stolen
+    timeslice into the whole round's cost."""
+
+    import subprocess
+
+    from paddlebox_tpu.ps.cluster import ServerMap
+    from paddlebox_tpu.ps.service import PSClient
+    from paddlebox_tpu.utils.monitor import stat_snapshot
+
+    n_keys = int(os.environ.get("BENCH_CLUSTER_KEYS", 400_000))
+    n_rounds = int(os.environ.get("BENCH_CLUSTER_ROUNDS", 12))
+    batch = int(os.environ.get("BENCH_CLUSTER_BATCH", 600_000))
+    n_wide = int(os.environ.get("BENCH_CLUSTER_SHARDS", 4))
+    n_reps = max(1, int(os.environ.get("BENCH_CLUSTER_REPS", 2)))
+    mf_dim = 8
+
+    # identical blocks for both fleet sizes: zipf-ranked draws into one
+    # fixed key universe (the production skew both configs must serve)
+    rng = np.random.default_rng(23)
+    universe = rng.choice(2 ** 40, n_keys, replace=False).astype(np.uint64)
+    blocks = [np.unique(universe[
+        np.minimum(rng.zipf(1.3, size=batch), n_keys) - 1])
+        for _ in range(n_rounds)]
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    def spawn(n):
+        """n shard processes; returns (procs, addrs) once all announce."""
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "paddlebox_tpu.ps.server_main",
+             "--port", "0", "--mf_dim", str(mf_dim), "--seed", "5"],
+            cwd=repo, env=env, stdout=subprocess.PIPE, text=True)
+            for _ in range(n)]
+        addrs = []
+        for p in procs:
+            line = p.stdout.readline().strip()
+            host, _, port = line.rpartition(" ")[2].rpartition(":")
+            addrs.append((host, int(port)))
+        return procs, addrs
+
+    def reap(procs):
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    def verb_round(client, b):
+        """One pull+push of block b; → seconds (pull/push are state-
+        idempotent after warm, so repeats time the same work)."""
+        t0 = time.perf_counter()
+        rows = client.pull_sparse(b, create=True)
+        client.push_sparse(b, rows)
+        return time.perf_counter() - t0
+
+    def timed_rounds(client, label, reps=1):
+        """Pull+push every block through one client; each round is the
+        min over `reps` repeats; → (wall, keys)."""
+        keys_done = 0
+        wall = 0.0
+        for i, b in enumerate(blocks):
+            if i % 4 == 0:
+                set_phase(f"{tag}:cluster[{label} {i}/{n_rounds}]", 300)
+            wall += min(verb_round(client, b) for _ in range(reps))
+            keys_done += 2 * len(b)
+        return wall, keys_done
+
+    def drive_one():
+        procs, addrs = spawn(1)
+        client = None
+        try:
+            client = PSClient(addrs)
+            for b in blocks:                       # warm: resident + conn
+                client.pull_sparse(b, create=True)
+            wall, keys_done = timed_rounds(client, "n=1", reps=n_reps)
+            return {"wall_s": round(wall, 3),
+                    "keys_s": round(keys_done / max(wall, 1e-9)),
+                    "keys": int(keys_done)}
+        finally:
+            if client is not None:
+                client.close()
+            reap(procs)
+
+    def drive_wide():
+        procs, addrs = spawn(n_wide)
+        smap = ServerMap(addrs)
+        fan = None
+        per_shard = []
+        try:
+            fan = PSClient(addrs)
+            for b in blocks:                       # warm all shards
+                fan.pull_sparse(b, create=True)
+            # live concurrent fan-out: exercises _pipeline_sharded +
+            # the shared inflight budget, lands slowest_shard_stall_s
+            wall, keys_done = timed_rounds(fan, f"n={n_wide}")
+            # critical path: each shard serves its partition with the
+            # core to itself; a round costs what its slowest shard costs
+            per_shard = [PSClient((h, p)) for h, p in addrs]
+            parts = [smap.partition(b) for b in blocks]
+            critical = 0.0
+            for i, (b, pos) in enumerate(zip(blocks, parts)):
+                if i % 4 == 0:
+                    set_phase(f"{tag}:cluster[crit {i}/{n_rounds}]", 300)
+                critical += max(
+                    min(verb_round(cl, b[pos[s]]) for _ in range(n_reps))
+                    for s, cl in enumerate(per_shard))
+            return {"wall_s": round(wall, 3),
+                    "keys_s": round(keys_done / max(wall, 1e-9)),
+                    "keys": int(keys_done),
+                    "critical_path_s": round(critical, 3),
+                    "agg_keys_s": round(keys_done / max(critical, 1e-9))}
+        finally:
+            if fan is not None:
+                fan.close()
+            for cl in per_shard:
+                cl.close()
+            reap(procs)
+
+    one = drive_one()
+    wide = drive_wide()
+    snap = stat_snapshot("ps.cluster.")
+    stall = float(snap.get("ps.cluster.slowest_shard_stall_s.max", 0.0))
+    return {"n1": one, "n4": wide, "n_shards": n_wide,
+            "rounds": n_rounds, "zipf_a": 1.3,
+            "ex_s": wide["agg_keys_s"],
+            "wire_speedup": round(
+                one["wall_s"] / max(wide["critical_path_s"], 1e-9), 2),
+            "slowest_shard_stall_s": round(stall, 4)}
+
+
 def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     """One full bench at a given geometry.  Returns the results dict;
     records partials into _STATE as they are measured."""
@@ -941,9 +1100,28 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
         except Exception as e:  # phase is diagnostic, never fatal
             trace(f"{tag}: serving bench failed: {type(e).__name__}: {e}")
 
+    cluster = {}
+    if tag == "full" and not legacy \
+            and os.environ.get("BENCH_CLUSTER", "1") == "1":
+        set_phase(f"{tag}:cluster", 600)
+        try:
+            cluster = _cluster_bench(tag)
+            record(cluster_wire_speedup=cluster["wire_speedup"],
+                   cluster_ex_s=cluster["ex_s"])
+            trace(f"{tag}: cluster n1={cluster['n1']['keys_s']:,} keys/s "
+                  f"n{cluster['n_shards']}={cluster['n4']['agg_keys_s']:,} "
+                  f"keys/s (critical-path basis) "
+                  f"wire_speedup={cluster['wire_speedup']:.2f}x "
+                  f"stall={cluster['slowest_shard_stall_s']:.4f}s")
+            if cluster["wire_speedup"] < 2.0:
+                trace(f"{tag}: WARNING cluster wire speedup below the 2x "
+                      "acceptance floor at N=4")
+        except Exception as e:  # phase is diagnostic, never fatal
+            trace(f"{tag}: cluster bench failed: {type(e).__name__}: {e}")
+
     return {"e2e": e2e_eps, "device_step": device_eps,
             "pass_cycle": pass_cycle, "recovery": recovery,
-            "cache": cache_cmp, "serving": serving,
+            "cache": cache_cmp, "serving": serving, "cluster": cluster,
             "batches": int(stats["batches"]), "examples": int(n_examples),
             "auc": round(float(stats.get("auc", float("nan"))), 4),
             "compile_s": round(compile_s, 1), "pass_pack_s": round(pack_s, 1),
@@ -1033,6 +1211,7 @@ def run() -> None:
          feed_gap_ratio=full["feed_gap_ratio"],
          pass_cycle=full["pass_cycle"], recovery=full["recovery"],
          cache=full["cache"], serving=full["serving"],
+         cluster=full["cluster"],
          feed_intervals=full["feed_intervals"], timers=full["timers"],
          timeline=_timeline_summary(), obs_stats=_obs_snapshot())
 
@@ -1400,6 +1579,16 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
         if shn > sho + 0.01:
             regressions.append(
                 f"serving.shed_rate {sho:.4f} -> {shn:.4f}")
+    clo = num(old.get("cluster") or {}, "wire_speedup")
+    cln = num(new.get("cluster") or {}, "wire_speedup")
+    if clo and cln is not None:         # lower fan-out speedup = regression
+        clfrac = (cln - clo) / clo
+        out["cluster_wire_speedup"] = {"old": clo, "new": cln,
+                                       "delta_frac": round(clfrac, 4)}
+        if clfrac < -threshold:
+            regressions.append(
+                f"cluster.wire_speedup {clo:.2f}x -> {cln:.2f}x "
+                f"({clfrac:+.1%})")
     mo = num(old.get("recovery") or {}, "mttr_s")
     mn = num(new.get("recovery") or {}, "mttr_s")
     if mo and mn is not None:           # slower recovery = regression

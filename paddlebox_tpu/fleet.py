@@ -224,6 +224,10 @@ def train_passes(trainer: SparseTrainer, dataset: BoxPSDataset,
     so indices still line up with ``passes``."""
     from paddlebox_tpu import flags as _flags
     from paddlebox_tpu.data.prefetch import PassPrefetcher
+    from paddlebox_tpu.io import checkpoint as _ckpt  # noqa: F401 -- the
+    # auto_resume/ckpt_dir/ckpt_every_passes flags read below are
+    # registered by this module's import; without it a caller that never
+    # touched io.checkpoint gets KeyError("undefined flag")
     from paddlebox_tpu.metrics import quality as _quality
     from paddlebox_tpu.ps import faults as _faults
     from paddlebox_tpu.utils.backoff import Backoff as _Backoff

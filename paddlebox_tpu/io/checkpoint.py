@@ -214,9 +214,17 @@ class TrainCheckpoint:
         with open(os.path.join(tmpdir, "dense.msgpack"), "wb") as f:
             f.write(serialization.to_bytes(dense))
 
+        # cluster topology rides in the generation record: at n > 1 the
+        # sparse dir holds per-shard ``shard-<k:03d>/`` subdirs (the
+        # client's save fan-out, ps/cluster.cluster_save) and THIS
+        # MANIFEST advance below is the single cluster-wide commit point
+        # naming all N shard heads at once
+        n_shards = getattr(getattr(engine.table, "server_map", None),
+                           "n", 1)
         state = {"generation": gen, "kind": kind, "chain": chain,
                  "day_id": engine.day_id, "pass_id": engine.pass_id,
-                 "phase": engine.phase, "rows": int(rows)}
+                 "phase": engine.phase, "rows": int(rows),
+                 "shards": int(n_shards)}
         if extra:
             state.update(extra)
         with open(os.path.join(tmpdir, "STATE.json"), "w") as f:
@@ -233,7 +241,8 @@ class TrainCheckpoint:
             # complete, pointer not yet advanced → old generation loads
             faults.on_lifecycle("ckpt_commit")
         _atomic_write(os.path.join(self.root, MANIFEST),
-                      json.dumps({"generation": gen}).encode())
+                      json.dumps({"generation": gen,
+                                  "shards": int(n_shards)}).encode())
         dt = time.monotonic() - t0
         stat_observe("ckpt.save_s", dt)
         stat_set("ckpt.generation", float(gen))
@@ -267,21 +276,30 @@ class TrainCheckpoint:
                           kept=len(keep))
 
     # -- resume --------------------------------------------------------------
-    def load_table(self, table) -> Optional[int]:
+    def load_table(self, table, shard: Optional[int] = None
+                   ) -> Optional[int]:
         """Table-only restore (the PSServerSupervisor's cross-process
         reload path, launch.py): walk the head generation's chain into
         ``table`` — base load, then delta upserts — without touching any
-        trainer state.  A server-side table also recovers its dedup
-        window here (the load verb restores DEDUP.bin, ps/service.py).
-        Returns the head generation number, or None when empty."""
+        trainer state.  ``shard`` narrows the walk to one cluster
+        shard's ``shard-<k:03d>/`` subdirs (a restarting shard reloads
+        ONLY its own rows + DEDUP.bin).  A server-side table also
+        recovers its dedup window here (the load verb restores DEDUP.bin,
+        ps/service.py).  Returns the head generation number, or None when
+        empty."""
         head = self._manifest()
         if head is None:
             return None
         chain = self._state(head).get("chain", [head])
-        table.load(os.path.join(self._gen_dir(chain[0]), "sparse"))
+
+        def sparse_dir(n: int) -> str:
+            p = os.path.join(self._gen_dir(n), "sparse")
+            return p if shard is None else os.path.join(
+                p, f"shard-{shard:03d}")
+
+        table.load(sparse_dir(chain[0]))
         for n in chain[1:]:
-            table.load(os.path.join(self._gen_dir(n), "sparse"),
-                       mode="upsert")
+            table.load(sparse_dir(n), mode="upsert")
         return head
 
     def resume(self, engine: BoxPSEngine, trainer) -> Optional[Dict]:
@@ -335,6 +353,66 @@ def save_xbox(engine: BoxPSEngine, path: str, base: bool = True) -> int:
     feature — key \\t show \\t click \\t embed_w \\t mf...  Quantization of
     embedx (quant_bits) applies here when configured.
 
+    A local engine table dumps in-process (dump_table_xbox).  An engine
+    running against a remote PS — including an N-way sharded cluster —
+    asks each server to dump ITS rows server-side (the ``dump_xbox``
+    verb) into per-shard part files, then concatenates them; row
+    ownership is disjoint by the ServerMap, so the concatenation is the
+    exact union and the downstream last-wins load semantics are
+    unaffected by part order.
+    """
+    acc = engine.config.accessor
+    qbits = engine.config.quant_bits
+    table = engine.table
+    if not hasattr(table, "_shards") and hasattr(table, "client"):
+        return _save_xbox_remote(
+            table.client, getattr(table, "table", None), path, base,
+            float(acc.base_threshold), float(acc.delta_threshold),
+            int(qbits or 0))
+    return dump_table_xbox(table, path, base=base,
+                           base_threshold=float(acc.base_threshold),
+                           delta_threshold=float(acc.delta_threshold),
+                           quant_bits=int(qbits or 0))
+
+
+def _save_xbox_remote(client, table_name: Optional[str], path: str,
+                      base: bool, base_threshold: float,
+                      delta_threshold: float, quant_bits: int) -> int:
+    """Fan the xbox dump out across the PS cluster: every shard writes a
+    ``<path>.shard-<k:03d>`` part server-side (itself tmp+rename atomic),
+    then the parts concatenate under ``path + ".tmp"`` and rename into
+    place — the published file appears atomically, never partially."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    n = 0
+    parts = []
+    for shard in range(getattr(client, "n_shards", 1)):
+        part = f"{path}.shard-{shard:03d}"
+        resp = client._call(
+            {"cmd": "dump_xbox", "path": part, "base": base,
+             "base_threshold": base_threshold,
+             "delta_threshold": delta_threshold,
+             "quant_bits": quant_bits, "table": table_name},
+            shard=shard, timeout=120)
+        n += int(resp["dumped"])
+        parts.append(part)
+    tmp_path = path + ".tmp"
+    with open(tmp_path, "wb") as out:
+        for part in parts:
+            with open(part, "rb") as fh:
+                shutil.copyfileobj(fh, out)
+            os.remove(part)
+    os.replace(tmp_path, path)
+    return n
+
+
+def dump_table_xbox(table, path: str, base: bool = True,
+                    base_threshold: float = 0.0,
+                    delta_threshold: float = 0.0,
+                    quant_bits: int = 0) -> int:
+    """Dump one LOCAL ShardedHostTable in the xbox TSV format — the body
+    shared by the in-process save_xbox path and the server-side
+    ``dump_xbox`` verb (each cluster shard dumps its own rows).
+
     Row selection/masking is vectorized per shard and formatting runs in
     the native TSV writer (native/dump_writer.cc, ≙ the reference's
     native dump IO through PaddleFileMgr) with a per-row Python fallback.
@@ -344,18 +422,17 @@ def save_xbox(engine: BoxPSEngine, path: str, base: bool = True) -> int:
     """
     from paddlebox_tpu.native import dump_writer
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    acc = engine.config.accessor
-    qbits = engine.config.quant_bits
+    qbits = quant_bits
     n = 0
     tmp_path = path + ".tmp"
     fh = None if dump_writer.available() else open(tmp_path, "w")
     try:
-        for shard in engine.table._shards:
+        for shard in table._shards:
             with shard.lock:
                 soa = shard.soa
-                score = engine.table._score(soa)
-                keep = (score >= acc.base_threshold) if base else \
-                    (np.abs(soa["delta_score"]) >= acc.delta_threshold)
+                score = table._score(soa)
+                keep = (score >= base_threshold) if base else \
+                    (np.abs(soa["delta_score"]) >= delta_threshold)
                 idx = np.nonzero(keep)[0]
                 if not len(idx):
                     continue

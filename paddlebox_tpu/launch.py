@@ -498,7 +498,8 @@ class PSServerSupervisor:
     def __init__(self, table, host: str = "127.0.0.1", port: int = 0,
                  max_restarts: int = 8, backoff_base: float = 0.05,
                  backoff_cap: float = 1.0, ckpt_root: Optional[str] = None,
-                 reload_from_ckpt: bool = False, poll_s: float = 0.02):
+                 reload_from_ckpt: bool = False, poll_s: float = 0.02,
+                 shard: Optional[int] = None):
         from paddlebox_tpu.ps.service import PSServer
         self._make = PSServer
         self.table = table
@@ -507,6 +508,9 @@ class PSServerSupervisor:
         self.restarts = 0
         self.ckpt_root = ckpt_root
         self.reload_from_ckpt = reload_from_ckpt
+        # cluster rank: a sharded fleet member reloads ONLY its own
+        # shard-<k:03d>/ checkpoint subdirs (rows + DEDUP.bin)
+        self.shard = shard
         self._backoff = (backoff_base, backoff_cap)
         self._poll_s = poll_s
         self._stop = threading.Event()
@@ -537,11 +541,14 @@ class PSServerSupervisor:
             from paddlebox_tpu.io.checkpoint import TrainCheckpoint
             from paddlebox_tpu.ps.service import _dedup_read
             ck = TrainCheckpoint(self.ckpt_root)
-            head = ck.load_table(self.table)
+            head = ck.load_table(self.table, shard=self.shard)
             dedup = None
             if head is not None:
-                dedup = _dedup_read(
-                    os.path.join(ck._gen_dir(head), "sparse"))
+                sparse = os.path.join(ck._gen_dir(head), "sparse")
+                if self.shard is not None:
+                    sparse = os.path.join(sparse,
+                                          f"shard-{self.shard:03d}")
+                dedup = _dedup_read(sparse)
         bo = Backoff(base=self._backoff[0], cap=self._backoff[1],
                      deadline=30.0)
         attempt = 0
@@ -580,6 +587,52 @@ class PSServerSupervisor:
         self._stop.set()
         self._watch.join(timeout=30.0)
         self.server.shutdown()
+
+
+class PSFleet:
+    """``--ps_servers N``: N supervised PSServers forming one sharded
+    cluster — rank-stable ports (rank k binds ``port_base + k`` when a
+    base is given), identically-seeded tables (fresh-row defaults are
+    pure in (seed, key), so any client sees one consistent key space),
+    and one :class:`PSServerSupervisor` per shard for restart-in-place
+    with per-shard dedup/checkpoint handoff (``shard-<k:03d>/`` subdirs
+    of the generation checkpoint, io/checkpoint.py).
+
+    ``env_value()`` is the ``PBOX_PS_ADDRS`` export — "host:port,..."
+    in rank order, which is also ServerMap order: every worker parsing
+    it derives the SAME key→shard placement."""
+
+    def __init__(self, n: int, config=None, seed: int = 0,
+                 host: str = "127.0.0.1", port_base: int = 0,
+                 mf_dim: int = 8, ckpt_root: Optional[str] = None,
+                 reload_from_ckpt: bool = False, max_restarts: int = 8):
+        from paddlebox_tpu.config import EmbeddingTableConfig
+        from paddlebox_tpu.ps.host_table import ShardedHostTable
+        if n < 1:
+            raise ValueError("PSFleet needs n >= 1 servers")
+        cfg = config or EmbeddingTableConfig(embedding_dim=mf_dim)
+        self.n = n
+        self.sups = [PSServerSupervisor(
+            ShardedHostTable(cfg, seed=seed),
+            host=host,
+            port=(port_base + k) if port_base else 0,
+            shard=(k if n > 1 else None),
+            ckpt_root=ckpt_root,
+            reload_from_ckpt=reload_from_ckpt,
+            max_restarts=max_restarts)
+            for k in range(n)]
+
+    @property
+    def addrs(self):
+        return [s.addr for s in self.sups]
+
+    def env_value(self) -> str:
+        from paddlebox_tpu.ps import cluster as ps_cluster
+        return ps_cluster.format_addrs(self.addrs)
+
+    def stop(self) -> None:
+        for s in self.sups:
+            s.stop()
 
 
 class ServingReplicaSupervisor:
@@ -833,6 +886,24 @@ def main():
                     help="evaluate the SLO rule set on every timeline "
                          "sample (FLAGS_obs_slo_watchdog; breaches emit "
                          "latched slo_breach flight events).  1 = on")
+    ap.add_argument("--ps_servers", type=int, default=0,
+                    help="start N supervised PSServer shards in the "
+                         "launcher process (one PSServerSupervisor each, "
+                         "rank-stable ports, restart-in-place with "
+                         "per-shard dedup/checkpoint handoff) and export "
+                         "PBOX_PS_ADDRS so every worker's PSClient fans "
+                         "chunked verbs across the cluster.  0 = off")
+    ap.add_argument("--ps_port_base", type=int, default=0,
+                    help="shard k binds ps_port_base + k (0 = ephemeral "
+                         "ports; rank order stays the ServerMap order "
+                         "either way)")
+    ap.add_argument("--ps_mf_dim", type=int, default=8,
+                    help="PS fleet table embedding_dim — must match the "
+                         "training script's table config")
+    ap.add_argument("--ps_seed", type=int, default=0,
+                    help="PS fleet fresh-row seed; all shards share it "
+                         "(defaults are pure in (seed, key), so the "
+                         "cluster key space is consistent)")
     ap.add_argument("--serve", type=int, default=0,
                     help="run N supervised read-only serving replicas "
                          "(ps/serving.py) instead of training workers; "
@@ -929,6 +1000,18 @@ def main():
         if not (args.serve_xbox or args.serve_manifest):
             ap.error("--serve needs --serve_xbox or --serve_manifest")
         sys.exit(serve_fleet(args))
+    ps_fleet = None
+    if args.ps_servers:
+        from paddlebox_tpu.ps import cluster as _ps_cluster
+        ps_fleet = PSFleet(
+            args.ps_servers, mf_dim=args.ps_mf_dim, seed=args.ps_seed,
+            port_base=args.ps_port_base,
+            ckpt_root=args.ckpt_dir or None,
+            reload_from_ckpt=bool(args.ckpt_dir),
+            max_restarts=max(args.max_restarts, 8))
+        os.environ[_ps_cluster.ADDRS_ENV] = ps_fleet.env_value()
+        for k, (h, p) in enumerate(ps_fleet.addrs):
+            print(f"[ps] shard {k} {h}:{p}", file=sys.stderr)
     proxy = None
     if args.chaos_backend:
         from paddlebox_tpu.ps.faults import ChaosProxy, FaultPlan
@@ -957,6 +1040,8 @@ def main():
     finally:
         if proxy is not None:
             proxy.shutdown()
+        if ps_fleet is not None:
+            ps_fleet.stop()
     sys.exit(rc)
 
 

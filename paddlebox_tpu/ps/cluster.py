@@ -1,0 +1,298 @@
+"""Sharded PS cluster topology — key-space partitioning and 2-phase lifecycle.
+
+≙ the reference's multi-server deployment (PAPER.md L5b: `brpc_ps_client`
+routing `key % shard_num` across `brpc_ps_server` processes,
+`boxps::MPICluster`): a :class:`ServerMap` assigns every feasign to exactly
+one of N parameter servers by a deterministic splitmix64 hash, so placement
+is stable across runs, restarts, and client instances — the property that
+makes N=1 and N=4 training bit-identical (each key's row lives on exactly
+one shard, fresh-row defaults are pure in (seed, key), and per-key RMW
+order within a shard is unchanged by the partition).
+
+The hash salt is DISTINCT from the host-table's internal shard salt so the
+two partitions decorrelate: a server's local `ShardedHostTable` spreads its
+subset of the key space evenly across its own lock shards regardless of
+which cluster shard it is.
+
+Cluster-wide lifecycle (`end_day`, and any future decaying verb) is
+2-phase over the per-server dedup windows: ``lifecycle_prepare`` on every
+shard under a pinned rid-group, then ``lifecycle_commit`` only after all N
+prepared.  Every phase rid is deterministic (``<group>.p<k>`` /
+``<group>.c<k>``), so a caller-level retry after a partial failure replays
+through the dedup windows — shards that already prepared/committed return
+their cached ack, shards that didn't execute once.  Exactly-once decay
+survives any single-shard crash + supervisor restart because the dedup
+window itself is part of the restart handoff (service.dedup_state /
+DEDUP.bin).  The commit frame carries the full verb (not just the txn id):
+a restarted server that lost its staged-txn dict can still execute the
+commit directly.
+
+Checkpoint fan-out: `cluster_save`/`cluster_load` write/read per-shard
+``shard-<k:03d>/`` subdirectories under the caller's path.  Because all N
+subdirs live inside one generation tmpdir, the PR 8 tmp+rename commit and
+the single cluster MANIFEST atomically advance ALL shards together —
+crash recovery rolls every shard back to the same generation.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.ps import wire
+from paddlebox_tpu.ps.feature_value import _keyed_hash
+from paddlebox_tpu.utils import lockdep
+from paddlebox_tpu.utils.monitor import stat_add, stat_observe
+
+# Cluster-placement salt — deliberately distinct from any host-table
+# internal salt so cluster-shard and lock-shard partitions decorrelate.
+CLUSTER_SALT = 0x9E2A5C7B3D41F68D
+
+# env var exporting the PS fleet's addresses to spawned workers
+# (cluster analogue of the single-server PBOX_PS_ADDR)
+ADDRS_ENV = "PBOX_PS_ADDRS"
+
+# lifecycle verbs legal inside a 2-phase cluster transaction
+LIFECYCLE_VERBS = ("end_day",)
+
+
+def shard_dir(path: str, shard: int) -> str:
+    """Per-shard subdirectory of a cluster checkpoint/dump path."""
+    return os.path.join(path, f"shard-{shard:03d}")
+
+
+def format_addrs(addrs: Sequence[Tuple[str, int]]) -> str:
+    return ",".join(f"{h}:{p}" for h, p in addrs)
+
+
+def parse_addrs(spec: str) -> List[Tuple[str, int]]:
+    """Parse "host:port,host:port,..." (the ADDRS_ENV format)."""
+    out: List[Tuple[str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        out.append((host, int(port)))
+    return out
+
+
+def addrs_from_env() -> Optional[List[Tuple[str, int]]]:
+    spec = os.environ.get(ADDRS_ENV, "")
+    return parse_addrs(spec) if spec else None
+
+
+class ServerMap:
+    """Deterministic key-hash → shard assignment over N server addresses.
+
+    splitmix64 on (key ^ CLUSTER_SALT) mod N: seed-stable, uniform, and
+    independent of insertion order — the same key always routes to the
+    same shard for every client of the same fleet size.
+    """
+
+    __slots__ = ("addrs", "n")
+
+    def __init__(self, addrs: Sequence[Tuple[str, int]]):
+        if not addrs:
+            raise ValueError("ServerMap needs at least one server address")
+        self.addrs: List[Tuple[str, int]] = [tuple(a) for a in addrs]
+        self.n = len(self.addrs)
+
+    def shard_of_keys(self, keys: np.ndarray) -> np.ndarray:
+        """Vectorized shard id per key (int64; all zeros when n == 1)."""
+        keys = np.asarray(keys, np.uint64)
+        if self.n == 1:
+            return np.zeros(keys.shape, np.int64)
+        return (_keyed_hash(keys, CLUSTER_SALT) % np.uint64(self.n)) \
+            .astype(np.int64)
+
+    def shard_of_key(self, key: int) -> int:
+        return int(self.shard_of_keys(np.asarray([key], np.uint64))[0])
+
+    def partition(self, keys: np.ndarray) -> List[np.ndarray]:
+        """Positions of each shard's keys in the original array.
+
+        Returns ``pos`` with ``len(pos) == n``; ``pos[s]`` preserves the
+        caller's relative order, which keeps per-shard chunk payloads —
+        and therefore pinned-rid replay bytes — deterministic.
+        """
+        shards = self.shard_of_keys(keys)
+        return [np.flatnonzero(shards == s) for s in range(self.n)]
+
+
+class _InflightBudget:
+    """Shared in-flight chunk cap across the per-shard pipeline runs.
+
+    One sharded verb drives N concurrent :class:`_PipelineRun` s; this
+    budget keeps the TOTAL frames in flight at the single-server window,
+    so fan-out multiplies wire concurrency without multiplying client
+    memory.  Lock order: a run's _cv is always taken BEFORE this lock
+    (take() probes under its cv); release() never holds both — it drops
+    the budget lock, then notifies each registered run cv with nothing
+    held, so no cycle can form between same-named run cvs.
+    """
+
+    def __init__(self, cap: int):
+        self.cap = max(1, int(cap))
+        self._used = 0
+        self._lock = lockdep.lock("ps.cluster._InflightBudget._lock")
+        self._run_cvs: List = []
+
+    def register(self, cv) -> None:
+        with self._lock:
+            self._run_cvs.append(cv)
+
+    def try_acquire(self) -> bool:
+        with self._lock:
+            if self._used < self.cap:
+                self._used += 1
+                return True
+            return False
+
+    def release(self, n: int = 1) -> None:
+        with self._lock:
+            self._used = max(0, self._used - n)
+            cvs = list(self._run_cvs)
+        for cv in cvs:
+            with cv:
+                cv.notify_all()
+
+
+def two_phase_lifecycle(client, verb: str, table: Optional[str] = None,
+                        timeout: float = 60.0):
+    """Run a decaying lifecycle verb cluster-wide, exactly once per shard.
+
+    n == 1 degrades to the plain single-server dedup'd send (byte- and
+    rid-identical to the pre-cluster client).  n > 1 runs prepare on
+    every shard, then commit only after ALL prepared; the rid group is
+    pinned on the client until the commit completes, so a caller-level
+    retry after any partial failure re-drives the SAME rids and the
+    per-shard dedup windows collapse duplicates.
+    """
+    if verb not in LIFECYCLE_VERBS:
+        raise ValueError(f"not a cluster lifecycle verb: {verb!r}")
+    n = getattr(client, "n_shards", 1)
+    if n <= 1:
+        return client._call({"cmd": verb, "table": table}, dedup=True,
+                            timeout=timeout)
+    t0 = time.perf_counter()
+    txn_key = (verb, table or "")
+    group = client._txn_groups.get(txn_key)
+    if group is None:
+        group = client.new_rid_group()
+        client._txn_groups[txn_key] = group
+    prepared: List[int] = []
+    try:
+        for shard in range(n):
+            client._call({"cmd": "lifecycle_prepare", "verb": verb,
+                          "table": table, "txn": group,
+                          wire.RID_FIELD: f"{group}.p{shard}"},
+                         shard=shard, timeout=timeout)
+            prepared.append(shard)
+    except Exception:
+        # Best-effort abort of staged shards; the group stays pinned, so
+        # a caller retry replays the same prepare rids (dedup'd) and can
+        # still commit — abort only clears server-side staging bookkeeping.
+        for shard in prepared:
+            try:
+                client._call({"cmd": "lifecycle_abort", "verb": verb,
+                              "table": table, "txn": group,
+                              wire.RID_FIELD: f"{group}.a{shard}"},
+                             shard=shard, timeout=5.0)
+            except Exception:
+                pass
+        stat_add("ps.cluster.lifecycle_abort")
+        raise
+    out = None
+    for shard in range(n):
+        out = client._call({"cmd": "lifecycle_commit", "verb": verb,
+                            "table": table, "txn": group,
+                            wire.RID_FIELD: f"{group}.c{shard}"},
+                           shard=shard, timeout=timeout)
+    client._txn_groups.pop(txn_key, None)
+    stat_add("ps.cluster.lifecycle_commit")
+    stat_observe("ps.cluster.lifecycle_s", time.perf_counter() - t0)
+    return out
+
+
+def _fan_out(client, build_req, timeout: float) -> List[Dict]:
+    """Send one request per shard concurrently; list of responses by shard.
+
+    Control-plane fan-out (save/load/size/health — one frame per shard,
+    no chunk streams), so plain threads over `_call` are enough; the row
+    verbs use the budgeted per-shard pipeline instead.
+    """
+    n = client.n_shards
+    out: List[Optional[Dict]] = [None] * n
+    errs: List[Optional[BaseException]] = [None] * n
+
+    def drive(shard: int) -> None:
+        try:
+            out[shard] = client._call(build_req(shard), shard=shard,
+                                      timeout=timeout)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errs[shard] = e
+
+    threads = [threading.Thread(target=drive, args=(s,), daemon=True)
+               for s in range(1, n)]
+    for t in threads:
+        t.start()
+    drive(0)
+    for t in threads:
+        t.join()
+    for shard, e in enumerate(errs):
+        if e is not None:
+            raise e
+    return out  # type: ignore[return-value]
+
+
+def cluster_save(client, path: str, mode: str = "all",
+                 keys: Optional[np.ndarray] = None,
+                 table: Optional[str] = None) -> int:
+    """Fan `save` out per shard into ``shard-<k:03d>/`` subdirs.
+
+    EVERY shard saves every generation — even one with zero delta keys —
+    because the dump is also where that shard's DEDUP.bin lands; a
+    restarting supervisor needs a current dedup window from its own
+    subdir regardless of how the delta keys hashed.
+    """
+    n = getattr(client, "n_shards", 1)
+    if n <= 1:
+        req: Dict = {"cmd": "save", "path": path, "mode": mode,
+                     "table": table}
+        if keys is not None:
+            req["keys"] = np.asarray(keys, np.uint64)
+        return int(client._call(req, timeout=120)["saved"])
+    pos = None
+    if keys is not None:
+        keys = np.asarray(keys, np.uint64)
+        pos = client.server_map.partition(keys)
+
+    def build(shard: int) -> Dict:
+        req = {"cmd": "save", "path": shard_dir(path, shard), "mode": mode,
+               "table": table}
+        if pos is not None:
+            req["keys"] = keys[pos[shard]]
+        return req
+
+    out = _fan_out(client, build, timeout=120)
+    return sum(int(r["saved"]) for r in out)
+
+
+def cluster_load(client, path: str, mode: str = "all",
+                 table: Optional[str] = None) -> int:
+    """Fan `load` out per shard from ``shard-<k:03d>/`` subdirs."""
+    n = getattr(client, "n_shards", 1)
+    if n <= 1:
+        return int(client._call({"cmd": "load", "path": path, "mode": mode,
+                                 "table": table}, timeout=120)["loaded"])
+    out = _fan_out(
+        client,
+        lambda shard: {"cmd": "load", "path": shard_dir(path, shard),
+                       "mode": mode, "table": table},
+        timeout=120)
+    return sum(int(r["loaded"]) for r in out)

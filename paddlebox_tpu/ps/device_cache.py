@@ -130,6 +130,29 @@ class DeviceRowCache:
         self._store: Optional[Dict[str, jnp.ndarray]] = None
         self._mirror: Optional[Dict[str, np.ndarray]] = None
         self.row_bytes = 0          # f32-basis host bytes per cached row
+        # cluster topology (optional): the fleet's ServerMap plus this
+        # device's rank/world.  With a map attached, admission is keyed
+        # by the SAME splitmix64 placement the PS cluster uses — each
+        # device caches a disjoint slice of the key space, so aggregate
+        # cache capacity (and hit rate) scales with the device count
+        # instead of every device burning HBM on the same head rows.
+        self._server_map = None
+        self._device_rank = 0
+        self._device_world = 1
+
+    def attach_server_map(self, server_map, device_rank: int = 0,
+                          device_world: int = 1) -> None:
+        """Adopt the PS cluster's key placement for cache admission.
+
+        ``shard_of_keys(key) % device_world == device_rank`` defines this
+        device's owned slice.  Already-resident rows outside the slice are
+        left to age out via normal eviction (attach happens before the
+        first admission in practice, so the set is empty).  Main thread
+        only, between passes.
+        """
+        self._server_map = server_map
+        self._device_rank = int(device_rank)
+        self._device_world = max(1, int(device_world))
 
     # -- index (cross-thread surface) ---------------------------------------
     def snapshot(self) -> CacheIndexSnapshot:
@@ -254,6 +277,12 @@ class DeviceRowCache:
         cand_mask = np.ones((n,), bool)
         cand_mask[res_idx] = False
         cand = np.flatnonzero(cand_mask)
+        if self._server_map is not None and self._device_world > 1:
+            # sharded topology: only admit this device's owned slice of
+            # the key space (same ServerMap placement the wire uses)
+            owned = (self._server_map.shard_of_keys(keys[cand])
+                     % self._device_world) == self._device_rank
+            cand = cand[owned]
         order = np.lexsort((keys[cand], -scores[cand]))
         cand = cand[order]
 
@@ -344,3 +373,33 @@ class DeviceRowCache:
         stat_add("ps.cache.invalidations")
         flight.record("cache_invalidate", reason=reason or "unspecified",
                       dropped=had)
+
+    def invalidate_shard(self, shard: int, reason: str = "") -> None:
+        """Drop only one PS cluster shard's resident rows (single-shard
+        supervisor restart behind a fan-out: the other N-1 shards never
+        lost state, so their cached rows stay hot).  Falls back to a full
+        invalidate when no ServerMap is attached.  Main thread only."""
+        if self._server_map is None:
+            self.invalidate(reason or f"shard-{shard}")
+            return
+        with self._lock:
+            keys = self._keys
+            slots = self._slots
+        hit = self._server_map.shard_of_keys(keys) == int(shard)
+        dropped = int(hit.sum())
+        drop_slots = slots[hit]
+        self._slot_key[drop_slots] = 0
+        self._slot_score[drop_slots] = 0.0
+        self._slot_pass[drop_slots] = -1
+        keep = ~hit
+        # version bump even when dropped == 0: in-flight snapshots may
+        # predate the restart and must resolve all-miss for safety
+        with self._lock:
+            self.version += 1
+            self._keys = keys[keep]
+            self._slots = slots[keep]
+            left = len(self._keys)
+        stat_set("ps.cache.resident_rows", float(left))
+        stat_add("ps.cache.invalidations")
+        flight.record("cache_invalidate_shard", shard=int(shard),
+                      reason=reason or "unspecified", dropped=dropped)

@@ -49,7 +49,8 @@ flags.define_flag(
 class BoxPSEngine:
     def __init__(self, config: Optional[EmbeddingTableConfig] = None,
                  topology: Optional[HybridTopology] = None, seed: int = 0,
-                 mode: str = "train"):
+                 mode: str = "train", device_rank: int = 0,
+                 device_world: int = 1):
         if mode not in ("train", "serving"):
             raise ValueError(f"mode must be 'train' or 'serving', "
                              f"got {mode!r}")
@@ -81,12 +82,17 @@ class BoxPSEngine:
         self._last_written: Optional[np.ndarray] = None
 
         # HBM tier: device-resident hot-row cache (ps/device_cache.py).
-        # Gated off under a sharded topology — the store would need the
-        # same row-sharding as the working set to avoid cross-device
-        # scatter traffic; single-device (the bench/test basis) first.
+        # No longer single-topology-gated: under a sharded PS cluster the
+        # cache keys admission by the fleet's ServerMap (attached lazily
+        # at the first feed pass — a remote table is wired to the engine
+        # AFTER __init__), and per-engine (device_rank, device_world)
+        # partitions the cached slice so aggregate cache capacity scales
+        # with the mesh instead of every engine caching the same head rows.
+        self.device_rank = int(device_rank)
+        self.device_world = max(1, int(device_world))
         self.cache: Optional[DeviceRowCache] = None
-        if mode == "train" and topology is None \
-                and flags.get_flags("ps_device_cache"):
+        self._cache_smap_attached = False
+        if mode == "train" and flags.get_flags("ps_device_cache"):
             cap = int(flags.get_flags("ps_device_cache_rows"))
             if cap > 0:
                 sgd = self.config.sgd
@@ -138,6 +144,17 @@ class BoxPSEngine:
         }
         flight.record("pass_feed_begin", pass_id=self.pass_id + 1,
                       day=self.day_id)
+        # lazy cluster attach: a RemoteTableAdapter over a sharded fleet
+        # is wired to the engine after __init__, so adopt its ServerMap
+        # for cache admission at the first feed that sees one
+        if self.cache is not None and not self._cache_smap_attached:
+            smap = getattr(self.table, "server_map", None)
+            if smap is not None:
+                self.cache.attach_server_map(
+                    smap, device_rank=self.device_rank,
+                    device_world=self.device_world)
+                # pboxlint: disable-next=PB102 -- single-coordinator lifecycle flag
+                self._cache_smap_attached = True
         # publish the cache index snapshot for THIS feed (prefetcher-safe:
         # the build thread intersects against this frozen view; authoritative
         # hit resolution re-checks the live index at adoption)

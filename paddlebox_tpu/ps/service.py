@@ -68,6 +68,7 @@ from typing import Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from paddlebox_tpu import flags
+from paddlebox_tpu.ps import cluster as ps_cluster
 from paddlebox_tpu.ps import faults, wire
 from paddlebox_tpu.ps.host_table import ShardedHostTable
 from paddlebox_tpu.utils import flight, lockdep, trace
@@ -277,7 +278,7 @@ class _DedupWindow:
 # e.g. a bulk pull response would blow the window's bounded memory
 _RID_ECHO_ONLY = frozenset({"pull_sparse", "pull_dense", "size",
                             "list_tables", "health", "save", "load",
-                            "forward"})
+                            "forward", "dump_xbox"})
 
 # dedup-window snapshot rides in the checkpointed sparse dir, next to the
 # shard files it must stay consistent with
@@ -360,6 +361,12 @@ class PSServer:
         # fleet/metrics/metric.py:144)
         self._reduce_cv = lockdep.condition("ps.service.PSServer._reduce_cv")
         self._reduces: Dict[str, Dict] = {}
+        # 2-phase cluster lifecycle staging (ps/cluster.py): txn id ->
+        # {verb, table}.  Observability/abort bookkeeping only — the
+        # commit frame is self-contained (carries the verb), so a
+        # supervisor restart that loses this dict cannot lose a commit.
+        self._staged_lock = lockdep.lock("ps.service.PSServer._staged_lock")
+        self._staged: Dict[str, Dict] = {}
         self._dedup = _DedupWindow(cap=flags.get_flags("ps_dedup_window"))
         if dedup_state:
             # restart-durable exactly-once: a supervisor restarting a dead
@@ -658,6 +665,51 @@ class PSServer:
         if cmd == "end_day":
             self._table(req).end_day()
             return {"ok": True}
+        if cmd == "lifecycle_prepare":
+            # phase 1 of the cluster-wide 2-phase lifecycle
+            # (ps/cluster.two_phase_lifecycle): validate + stage, execute
+            # NOTHING.  The rid entering the dedup window here is what
+            # makes a caller retry after partial failure exactly-once.
+            verb = req.get("verb")
+            if verb not in ("end_day",):
+                raise ValueError(f"unknown lifecycle verb: {verb!r}")
+            self._table(req)  # raises on unknown table before staging
+            with self._staged_lock:
+                self._staged[req["txn"]] = {"verb": verb,
+                                            "table": req.get("table")}
+            stat_add("ps.server.lifecycle_prepare")
+            return {"ok": True, "staged": True}
+        if cmd == "lifecycle_commit":
+            # phase 2: self-contained — executes from the frame's own
+            # verb/table, so a post-restart server with an empty _staged
+            # dict still applies it (the dedup window, which DID survive
+            # via handoff/DEDUP.bin, collapses duplicate commits)
+            verb = req.get("verb")
+            with self._staged_lock:
+                self._staged.pop(req.get("txn") or "", None)
+            if verb == "end_day":
+                self._table(req).end_day()
+            else:
+                raise ValueError(f"unknown lifecycle verb: {verb!r}")
+            stat_add("ps.server.lifecycle_commit")
+            return {"ok": True}
+        if cmd == "lifecycle_abort":
+            with self._staged_lock:
+                self._staged.pop(req.get("txn") or "", None)
+            stat_add("ps.server.lifecycle_abort")
+            return {"ok": True}
+        if cmd == "dump_xbox":
+            # server-side xbox dump of THIS shard's rows (cluster fan-out
+            # writes per-shard part files the client concatenates); lazy
+            # import avoids a ps -> io import at module load
+            from paddlebox_tpu.io.checkpoint import dump_table_xbox
+            n = dump_table_xbox(
+                self._table(req), req["path"],
+                base=bool(req.get("base", True)),
+                base_threshold=float(req.get("base_threshold", 0.0)),
+                delta_threshold=float(req.get("delta_threshold", 0.0)),
+                quant_bits=int(req.get("quant_bits", 0)))
+            return {"ok": True, "dumped": n}
         if cmd == "size":
             return {"ok": True, "size": self._table(req).size()}
         if cmd == "list_tables":
@@ -823,12 +875,17 @@ class PSServer:
 class _Stream:
     """One pooled PS connection.  A stream is EXCLUSIVELY checked out by a
     single verb (or pipeline pump) for the duration of its frame I/O, so
-    no lock is ever held across network calls (lint rule PB104)."""
+    no lock is ever held across network calls (lint rule PB104).  Each
+    stream is pinned to one cluster shard: it only ever dials (and its
+    chunks only ever requeue onto) that shard's address — a key's data
+    lives on exactly one server, so cross-shard failover of a chunk
+    would be meaningless."""
 
-    __slots__ = ("idx", "sock")
+    __slots__ = ("idx", "shard", "sock")
 
-    def __init__(self, idx: int):
+    def __init__(self, idx: int, shard: int = 0):
         self.idx = idx
+        self.shard = shard
         self.sock: Optional[socket.socket] = None
 
 
@@ -836,11 +893,16 @@ class _PipelineRun:
     """Shared state of one pipelined multi-chunk verb: the chunk queue,
     the sliding window, ordered results, and the abort latch.  Stream
     pumps call in from their own threads; every mutation happens under
-    the run's condition lock."""
+    the run's condition lock.  A sharded verb runs one _PipelineRun per
+    shard under a shared cluster ``budget`` (ps/cluster._InflightBudget)
+    capping TOTAL frames in flight across the fan-out; take() probes the
+    budget under this run's cv (lock order run._cv -> budget._lock) and
+    complete()/requeue() release it with no locks held."""
 
     def __init__(self, reqs: List[Dict], window: int,
-                 retries: Optional[int] = None):
+                 retries: Optional[int] = None, budget=None):
         self._cv = lockdep.condition("ps.service._PipelineRun._cv")
+        self.budget = budget
         self.n = len(reqs)
         self._queue = deque(enumerate(reqs))
         self.results: List[Optional[Dict]] = [None] * self.n
@@ -865,7 +927,8 @@ class _PipelineRun:
         stalled = 0.0
         with self._cv:
             while not self._stopped() and self._queue:
-                if self.inflight < self.window:
+                if self.inflight < self.window and \
+                        (self.budget is None or self.budget.try_acquire()):
                     job = self._queue.popleft()
                     self.inflight += 1
                     stat_max("ps.client.inflight_hwm", float(self.inflight))
@@ -886,6 +949,8 @@ class _PipelineRun:
             self.inflight -= 1
             self.done_count += 1
             self._cv.notify_all()
+        if self.budget is not None:
+            self.budget.release()
 
     def requeue(self, jobs: List[Tuple[int, Dict]]) -> None:
         """A stream died with these chunks unresolved — hand them back for
@@ -902,6 +967,8 @@ class _PipelineRun:
                         and self._attempts[idx] >= self.retries:
                     self.gave_up = True
             self._cv.notify_all()
+        if self.budget is not None:
+            self.budget.release(len(jobs))
         if self.gave_up:
             stat_add("ps.client.give_up")
             flight.record("verb_give_up", site="chunk_requeue")
@@ -939,14 +1006,30 @@ class PSClient:
     (deadline-bounded only); ``streams``/``window``/``wire_dtype`` default
     from FLAGS_ps_streams / FLAGS_ps_window / FLAGS_ps_wire_dtype."""
 
-    def __init__(self, addr: Tuple[str, int], retries: Optional[int] = 3,
+    def __init__(self, addr, retries: Optional[int] = 3,
                  retry_sleep: float = 0.1,
                  max_frame: int = wire.MAX_FRAME,
                  deadline: float = 60.0, backoff_cap: float = 2.0,
                  streams: Optional[int] = None,
                  window: Optional[int] = None,
                  wire_dtype: Optional[str] = None):
-        self.addr = tuple(addr)
+        # ``addr`` is one (host, port) — the classic single server — or a
+        # list of them: an N-way sharded PS cluster.  The ServerMap owns
+        # the deterministic key-hash -> shard placement; every row verb
+        # partitions its keys by it and fans per-shard chunk streams out
+        # concurrently (ps/cluster.py).  n == 1 is byte- and rid-
+        # identical to the pre-cluster client.
+        if addr and isinstance(addr[0], (tuple, list)):
+            addrs = [tuple(a) for a in addr]
+        else:
+            addrs = [tuple(addr)]
+        self.server_map = ps_cluster.ServerMap(addrs)
+        self.n_shards = self.server_map.n
+        self.addr = self.server_map.addrs[0]   # back-compat (shard 0)
+        # pinned 2-phase lifecycle rid-groups keyed by (verb, table):
+        # a caller retry of a partially-failed cluster lifecycle replays
+        # the SAME prepare/commit rids (ps/cluster.two_phase_lifecycle)
+        self._txn_groups: Dict[Tuple[str, str], str] = {}
         self.retries = retries
         self.retry_sleep = retry_sleep      # backoff base
         self.backoff_cap = backoff_cap
@@ -972,9 +1055,14 @@ class PSClient:
         # THIS dict and rid allocation only — never network I/O (PB104)
         self._row_bytes_est: Dict[str, int] = {}
         self._lock = lockdep.lock("ps.service.PSClient._lock")
-        # connection pool: streams check out exclusively via _pool_cv
-        self._pool = [_Stream(i) for i in range(self.streams)]
-        self._free: List[_Stream] = list(self._pool)
+        # connection pool: ``streams`` connections PER SHARD, checked out
+        # exclusively via one _pool_cv; a stream is pinned to its shard
+        self._pool = [_Stream(i, shard=s)
+                      for s in range(self.n_shards)
+                      for i in range(self.streams)]
+        self._free: List[List[_Stream]] = [
+            [st for st in self._pool if st.shard == s]
+            for s in range(self.n_shards)]
         self._pool_cv = lockdep.condition("ps.service.PSClient._pool_cv")
         # rid = token ":" seq — unique per client instance, monotonic
         self._token = f"c{os.getpid():x}-{os.urandom(4).hex()}"
@@ -1027,37 +1115,40 @@ class PSClient:
         return wire.quantize_rows(rows, self.wire_dtype, verb=verb)
 
     # -- stream pool ---------------------------------------------------------
-    def _checkout(self) -> _Stream:
+    def _checkout(self, shard: int = 0) -> _Stream:
         with self._pool_cv:
-            while not self._free:
+            while not self._free[shard]:
                 self._pool_cv.wait()
-            return self._free.pop()
+            return self._free[shard].pop()
 
-    def _checkout_upto(self, n: int) -> List[_Stream]:
-        """Up to ``n`` free streams — at least one (blocks for the first);
-        a concurrent verb holding part of the pool never deadlocks a
-        pipelined call, it just narrows it."""
+    def _checkout_upto(self, n: int, shard: int = 0) -> List[_Stream]:
+        """Up to ``n`` free streams of one shard — at least one (blocks
+        for the first); a concurrent verb holding part of the pool never
+        deadlocks a pipelined call, it just narrows it."""
         with self._pool_cv:
-            while not self._free:
+            while not self._free[shard]:
                 self._pool_cv.wait()
-            take = min(n, len(self._free))
-            out = [self._free.pop() for _ in range(take)]
+            take = min(n, len(self._free[shard]))
+            out = [self._free[shard].pop() for _ in range(take)]
             return out
 
     def _checkin(self, *streams: _Stream) -> None:
         with self._pool_cv:
-            self._free.extend(streams)
+            for st in streams:
+                self._free[st.shard].append(st)
             self._pool_cv.notify_all()
 
     def _connect(self, stream: _Stream, timeout: float,
                  bo: Backoff) -> None:
-        """Dial one pooled stream; the connect timeout honors the per-call
-        timeout and never outlives the remaining retry budget."""
+        """Dial one pooled stream to ITS shard's address; the connect
+        timeout honors the per-call timeout and never outlives the
+        remaining retry budget."""
         if faults.ACTIVE is not None:
             faults.on_connect("client")
         rem = bo.remaining()
         cto = timeout if rem is None else max(min(timeout, rem), 0.001)
-        stream.sock = socket.create_connection(self.addr, timeout=cto)
+        stream.sock = socket.create_connection(
+            self.server_map.addrs[stream.shard], timeout=cto)
 
     @staticmethod
     def _close_stream(stream: _Stream) -> None:
@@ -1077,7 +1168,7 @@ class PSClient:
 
     def _call(self, req: Dict, retry: bool = True,
               timeout: float = 60, deadline: Optional[float] = None,
-              dedup: bool = False) -> Dict:
+              dedup: bool = False, shard: int = 0) -> Dict:
         """One verb round-trip with retries on a checked-out stream.
 
         ``dedup=True`` stamps a fresh rid (or the caller presets
@@ -1105,20 +1196,21 @@ class PSClient:
         t_call = time.monotonic()
         try:
             return self._call_attempts(req, retry, timeout, deadline,
-                                       t_call)
+                                       t_call, shard)
         finally:
             if span is not None:
                 tr.finish(span)
 
     def _call_attempts(self, req: Dict, retry: bool, timeout: float,
-                       deadline: Optional[float], t_call: float) -> Dict:
+                       deadline: Optional[float], t_call: float,
+                       shard: int = 0) -> Dict:
         rid = req.get(wire.RID_FIELD)
         bo = Backoff(base=self.retry_sleep, cap=self.backoff_cap,
                      deadline=self.deadline if deadline is None
                      else deadline)
         attempt = 0
         while True:
-            stream = self._checkout()
+            stream = self._checkout(shard)
             try:
                 try:
                     if stream.sock is None:
@@ -1163,18 +1255,19 @@ class PSClient:
             return resp
 
     # -- pipelined chunk engine ---------------------------------------------
-    def _pipeline(self, reqs: List[Dict], timeout: float = 60
-                  ) -> List[Dict]:
-        """Drive chunk requests through the stream pool with up to
-        ``self.window`` frames in flight; returns responses in request
+    def _pipeline(self, reqs: List[Dict], timeout: float = 60,
+                  shard: int = 0) -> List[Dict]:
+        """Drive chunk requests through one shard's stream pool with up
+        to ``self.window`` frames in flight; returns responses in request
         order.  Every request must carry wire.RID_FIELD (the echo is the
         response-matching key).  Single-chunk calls and single-stream
         clients fall back to stop-and-wait ``_call``."""
         if not reqs:
             return []
         if len(reqs) == 1 or self.streams == 1:
-            return [self._call(r, timeout=timeout) for r in reqs]
-        streams = self._checkout_upto(min(self.streams, len(reqs)))
+            return [self._call(r, timeout=timeout, shard=shard)
+                    for r in reqs]
+        streams = self._checkout_upto(min(self.streams, len(reqs)), shard)
         run = _PipelineRun(reqs, self.window, retries=self.retries)
         depth = max(1, -(-self.window // len(streams)))  # ceil division
         pumps = [threading.Thread(target=self._pump_stream,
@@ -1196,6 +1289,75 @@ class PSClient:
                 f"pipelined {reqs[0].get('cmd')!r} incomplete "
                 f"({run.done_count}/{run.n} chunks): {run.net_error}")
         return run.results    # type: ignore[return-value]
+
+    def _pipeline_sharded(self, reqs_by_shard: Dict[int, List[Dict]],
+                          timeout: float = 60) -> Dict[int, List[Dict]]:
+        """Drive per-shard chunk request lists concurrently — one
+        _PipelineRun per shard over that shard's stream pool, all under a
+        SHARED inflight budget, so the fan-out multiplies wire
+        concurrency (N sockets actively moving frames) without
+        multiplying client memory (total frames in flight stays at the
+        single-server window).  Returns {shard: responses-in-order}.
+
+        Chunks never migrate between shards: a key's row lives on
+        exactly one server, so a failed stream requeues its chunks for
+        the SAME shard's surviving/reconnected streams only."""
+        live = {s: r for s, r in reqs_by_shard.items() if r}
+        if not live:
+            return {}
+        stat_observe("ps.cluster.fan_out_width", float(len(live)))
+        if len(live) == 1:
+            ((s, reqs),) = live.items()
+            return {s: self._pipeline(reqs, timeout=timeout, shard=s)}
+        budget = ps_cluster._InflightBudget(max(self.window, len(live)))
+        runs: Dict[int, _PipelineRun] = {}
+        held: List[_Stream] = []
+        jobs: List[Tuple[_Stream, _PipelineRun, int]] = []
+        finish: Dict[int, float] = {}
+        for s in sorted(live):
+            reqs = live[s]
+            streams = self._checkout_upto(min(self.streams, len(reqs)), s)
+            held.extend(streams)
+            run = _PipelineRun(reqs, self.window, retries=self.retries,
+                               budget=budget)
+            budget.register(run._cv)
+            runs[s] = run
+            depth = max(1, -(-self.window // len(streams)))
+            for st in streams:
+                jobs.append((st, run, depth))
+
+        def pump(st: _Stream, run: _PipelineRun, depth: int) -> None:
+            try:
+                self._pump_stream(st, run, timeout, depth)
+            finally:
+                # per-shard completion timestamp (last pump out wins):
+                # the spread across shards is the slowest-shard stall
+                finish[st.shard] = time.monotonic()
+
+        pumps = [threading.Thread(target=pump, args=j, daemon=True)
+                 for j in jobs[1:]]
+        for t in pumps:
+            t.start()
+        try:
+            pump(*jobs[0])
+        finally:
+            for t in pumps:
+                t.join()
+            self._checkin(*held)
+        for s, run in runs.items():
+            if run.error is not None:
+                raise run.error
+        for s, run in runs.items():
+            if not run.finished():
+                raise ConnectionError(
+                    f"pipelined {live[s][0].get('cmd')!r} incomplete on "
+                    f"shard {s} ({run.done_count}/{run.n} chunks): "
+                    f"{run.net_error}")
+        if len(finish) > 1:
+            stat_observe("ps.cluster.slowest_shard_stall_s",
+                         max(finish.values()) - min(finish.values()))
+        return {s: runs[s].results    # type: ignore[misc]
+                for s in live}
 
     def _pump_stream(self, stream: _Stream, run: _PipelineRun,
                      timeout: float, depth: int) -> None:
@@ -1389,6 +1551,8 @@ class PSClient:
         deterministic chunking for a given first response."""
         keys = np.asarray(keys)
         with trace.span("ps.client.pull_sparse.bulk", keys=len(keys)):
+            if self.n_shards > 1 and len(keys):
+                return self._pull_sparse_sharded(keys, table, create)
             return self._pull_sparse_chunked(keys, table, create)
 
     def _pull_sparse_chunked(self, keys: np.ndarray, table: Optional[str],
@@ -1421,10 +1585,95 @@ class PSClient:
         return {f: np.concatenate([p[f] for p in parts])
                 for f in parts[0]}
 
+    def _pull_sparse_sharded(self, keys: np.ndarray, table: Optional[str],
+                             create: bool) -> Dict[str, np.ndarray]:
+        """Cluster fan-out pull: partition keys by the ServerMap, drive
+        every shard's chunk stream concurrently (_pipeline_sharded), and
+        reassemble rows into the caller's key order by position.  Width
+        learning keeps the single probe-then-freeze discipline — the
+        probe goes to the shard holding the most keys; the learned width
+        then governs every shard's chunking (one schema per table)."""
+        smap = self.server_map
+        pos = smap.partition(keys)
+        tname = table or DEFAULT_TABLE
+        with self._lock:
+            learned = self._row_bytes_est.get(tname)
+        chunks: List[Tuple[np.ndarray, Dict[str, np.ndarray]]] = []
+        if learned is None:
+            probe_shard = int(np.argmax([len(p) for p in pos]))
+            per = min(self._per_chunk(512), 65536)
+            p = pos[probe_shard]
+            c = min(per, len(p))
+            sub = p[:c]
+            rows = self._call(self._pull_req(keys[sub], table, create),
+                              shard=probe_shard)["rows"]
+            chunks.append((sub, rows))
+            pos[probe_shard] = p[c:]
+            learned = max(self._rows_bytes(rows), 8)
+            with self._lock:
+                self._row_bytes_est[tname] = learned
+        per = self._per_chunk(learned)          # frozen for the fan-out
+        reqs_by_shard: Dict[int, List[Dict]] = {}
+        spans_by_shard: Dict[int, List[np.ndarray]] = {}
+        for shard in range(smap.n):
+            p = pos[shard]
+            if not len(p):
+                continue
+            stat_add(f"ps.cluster.s{shard}.pull_keys", float(len(p)))
+            stat_add(f"ps.cluster.s{shard}.est_bytes",
+                     float(len(p) * per))
+            reqs = []
+            spans = []
+            for lo, c in self._chunk_spans(len(p), per):
+                sub = p[lo:lo + c]
+                reqs.append(self._pull_req(keys[sub], table, create))
+                spans.append(sub)
+            reqs_by_shard[shard] = reqs
+            spans_by_shard[shard] = spans
+        results = self._pipeline_sharded(reqs_by_shard)
+        for shard, rlist in results.items():
+            for sub, resp in zip(spans_by_shard[shard], rlist):
+                chunks.append((sub, resp["rows"]))
+        template = chunks[0][1]
+        out = {f: np.empty((len(keys),) + np.asarray(v).shape[1:],
+                           np.asarray(v).dtype)
+               for f, v in template.items()}
+        for sub, rows in chunks:
+            for f in out:
+                out[f][sub] = rows[f]
+        return out
+
     def push_sparse(self, keys: np.ndarray, rows: Dict[str, np.ndarray],
                     table: Optional[str] = None):
         keys = np.asarray(keys)
         with trace.span("ps.client.push_sparse.bulk", keys=len(keys)):
+            if self.n_shards > 1 and len(keys):
+                per_row = self._rows_bytes(rows)
+                reqs_by_shard: Dict[int, List[Dict]] = {}
+                for shard, p in enumerate(
+                        self.server_map.partition(keys)):
+                    if not len(p):
+                        continue
+                    stat_add(f"ps.cluster.s{shard}.push_keys",
+                             float(len(p)))
+                    stat_add(f"ps.cluster.s{shard}.est_bytes",
+                             float(len(p) * per_row))
+                    sub_rows = {f: np.asarray(v)[p]
+                                for f, v in rows.items()}
+                    reqs = []
+                    for lo, c in self._chunk_counts(len(p), per_row):
+                        chunk = {f: v[lo:lo + c]
+                                 for f, v in sub_rows.items()}
+                        reqs.append(self._stamp_trace(
+                            {"cmd": "push_sparse",
+                             "keys": keys[p[lo:lo + c]],
+                             "rows": self._quant_rows(chunk,
+                                                      "push_sparse"),
+                             "table": table,
+                             wire.RID_FIELD: self._next_rid()}))
+                    reqs_by_shard[shard] = reqs
+                self._pipeline_sharded(reqs_by_shard)
+                return
             per_row = self._rows_bytes(rows)
             reqs = []
             for lo, c in self._chunk_counts(len(keys), per_row):
@@ -1456,6 +1705,41 @@ class PSClient:
         with trace.span("ps.client.push_sparse_delta.bulk",
                         keys=len(keys), group=group):
             per_row = self._rows_bytes(rows) + self._rows_bytes(rows_abs)
+            if self.n_shards > 1 and len(keys):
+                # sharded delta rids are ``<group>.<shard>.<i>``: the
+                # partition is a pure function of the keys, so a pinned-
+                # group caller replay reproduces byte-identical per-shard
+                # chunks under identical rids — exactly-once per shard
+                reqs_by_shard: Dict[int, List[Dict]] = {}
+                for shard, p in enumerate(
+                        self.server_map.partition(keys)):
+                    if not len(p):
+                        continue
+                    stat_add(f"ps.cluster.s{shard}.push_keys",
+                             float(len(p)))
+                    stat_add(f"ps.cluster.s{shard}.est_bytes",
+                             float(len(p) * per_row))
+                    sub_rows = {f: np.asarray(v)[p]
+                                for f, v in rows.items()}
+                    sub_abs = {f: np.asarray(v)[p]
+                               for f, v in rows_abs.items()}
+                    shard_reqs = []
+                    for i, (lo, c) in enumerate(
+                            self._chunk_counts(len(p), per_row)):
+                        delta = {f: v[lo:lo + c]
+                                 for f, v in sub_rows.items()}
+                        shard_reqs.append(self._stamp_trace(
+                            {"cmd": "push_sparse_delta",
+                             "keys": keys[p[lo:lo + c]],
+                             "rows": self._quant_rows(
+                                 delta, "push_sparse_delta"),
+                             "rows_abs": {f: v[lo:lo + c]
+                                          for f, v in sub_abs.items()},
+                             "table": table,
+                             wire.RID_FIELD: f"{group}.{shard}.{i}"}))
+                    reqs_by_shard[shard] = shard_reqs
+                self._pipeline_sharded(reqs_by_shard)
+                return
             reqs = []
             for i, (lo, c) in enumerate(
                     self._chunk_counts(len(keys), per_row)):
@@ -1483,27 +1767,48 @@ class PSClient:
 
     def save(self, path: str, mode: str = "all",
              table: Optional[str] = None, keys=None) -> int:
-        req = {"cmd": "save", "path": path, "mode": mode, "table": table}
-        if keys is not None:
-            req["keys"] = np.asarray(keys, np.uint64)
-        return self._call(req)["saved"]
+        """Durable dump — at n > 1 fans out into per-shard
+        ``shard-<k:03d>/`` subdirs of ``path`` (ps/cluster.cluster_save);
+        EVERY shard writes its DEDUP.bin there, so all N restart handoffs
+        stay current.  n == 1 keeps the flat single-server layout."""
+        return ps_cluster.cluster_save(self, path, mode=mode, keys=keys,
+                                       table=table)
 
     def load(self, path: str, table: Optional[str] = None,
              mode: str = "replace") -> int:
-        return self._call({"cmd": "load", "path": path, "mode": mode,
-                           "table": table})["loaded"]
+        return ps_cluster.cluster_load(self, path, mode=mode, table=table)
 
     def shrink(self, table: Optional[str] = None) -> int:
+        if self.n_shards > 1:
+            return sum(
+                int(self._call({"cmd": "shrink", "table": table},
+                               shard=s)["removed"])
+                for s in range(self.n_shards))
         return self._call({"cmd": "shrink", "table": table})["removed"]
 
     def end_day(self, table: Optional[str] = None) -> None:
-        # non-idempotent (counter decay) → exactly-once via rid
-        self._call({"cmd": "end_day", "table": table}, dedup=True)
+        # non-idempotent (counter decay) → exactly-once via rid; cluster-
+        # wide it is 2-phase over every shard's dedup window — ALL shards
+        # decay or none (ps/cluster.two_phase_lifecycle; lint rule PB801
+        # keeps every lifecycle send on this path)
+        ps_cluster.two_phase_lifecycle(self, "end_day", table=table)
 
     def size(self, table: Optional[str] = None) -> int:
+        if self.n_shards > 1:
+            return sum(
+                int(self._call({"cmd": "size", "table": table},
+                               shard=s)["size"])
+                for s in range(self.n_shards))
         return self._call({"cmd": "size", "table": table})["size"]
 
     def list_tables(self) -> Dict[str, int]:
+        if self.n_shards > 1:
+            out: Dict[str, int] = {}
+            for s in range(self.n_shards):
+                for name, n in self._call({"cmd": "list_tables"},
+                                          shard=s)["tables"].items():
+                    out[name] = out.get(name, 0) + int(n)
+            return out
         return self._call({"cmd": "list_tables"})["tables"]
 
     def forward(self, keys: np.ndarray, lod: np.ndarray,
@@ -1533,9 +1838,31 @@ class PSClient:
     def health(self, timeout: float = 5.0) -> Dict:
         """Heartbeat: liveness + drain state, cheap enough to poll.  The
         report carries this client's wire-pool shape alongside the
-        server's state: pool size, connected streams, window."""
-        resp = self._call({"cmd": "health"}, timeout=timeout,
-                          deadline=timeout)
+        server's state: pool size, connected streams, window.  At n > 1
+        the report AGGREGATES across shards — mode collapses when all
+        agree ("mixed" otherwise), draining is any-shard, inflight and
+        stats sum — and the raw per-shard reports ride in ``shards``."""
+        if self.n_shards > 1:
+            per = [self._call({"cmd": "health"}, timeout=timeout,
+                              deadline=timeout, shard=s)
+                   for s in range(self.n_shards)]
+            modes = {r.get("mode") for r in per}
+            stats: Dict[str, float] = {}
+            for r in per:
+                for k, v in (r.get("stats") or {}).items():
+                    stats[k] = stats.get(k, 0.0) + float(v)
+            resp = {"ok": True,
+                    "mode": modes.pop() if len(modes) == 1 else "mixed",
+                    "draining": any(r.get("draining") for r in per),
+                    "inflight": sum(int(r.get("inflight", 0))
+                                    for r in per),
+                    "tables": per[0].get("tables", ""),
+                    "stats": stats,
+                    "n_shards": self.n_shards,
+                    "shards": per}
+        else:
+            resp = self._call({"cmd": "health"}, timeout=timeout,
+                              deadline=timeout)
         with self._pool_cv:
             resp["pool_streams"] = len(self._pool)
             resp["pool_connected"] = sum(
@@ -1607,6 +1934,12 @@ class RemoteTableAdapter:
         # as the server computed them) — consumed by the engine's device-
         # cache fold-back; None outside delta_mode
         self._write_effect: Optional[Dict[str, np.ndarray]] = None
+
+    @property
+    def server_map(self) -> ps_cluster.ServerMap:
+        """The client's key-hash -> shard placement; consumers (device
+        cache sharding, checkpoint metadata) read the topology here."""
+        return self.client.server_map
 
     def pop_write_effect(self) -> Optional[Dict[str, np.ndarray]]:
         """The server-side value of the rows the last ``bulk_write``

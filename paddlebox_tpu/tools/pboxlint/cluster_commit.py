@@ -1,0 +1,111 @@
+"""PB8xx — PS-cluster commit discipline (the 2-phase lifecycle rule).
+
+With a sharded fleet (ps/cluster.py ServerMap), a lifecycle verb sent to
+ONE shard is a cluster-consistency bug: `end_day` decays show/click on
+that shard only (the table silently forks across shards), and a per-shard
+`save`/`load` outside the cluster fan-out bypasses the single-MANIFEST
+commit point that lets crash recovery roll every shard back together.
+All such verbs must route through the ps/cluster.py helpers
+(``two_phase_lifecycle`` / ``cluster_save`` / ``cluster_load``), which
+degrade to the plain single-server send when n == 1 — so there is never
+a reason for caller code to hand-build these frames.
+
+  PB801  a raw wire frame carrying a cluster lifecycle verb — a
+         ``_call``/``_call_attempts`` send whose request dict literal has
+         ``"cmd"`` ∈ {end_day, lifecycle_prepare, lifecycle_commit,
+         lifecycle_abort, save, load} — built outside ps/cluster.py.
+         The 2-phase helper owns these rids (``<group>.p<k>`` /
+         ``<group>.c<k>``): a hand-rolled send invents rids outside the
+         pinned txn group, so a retry after partial failure stops
+         deduplicating and exactly-once dies.  (``shrink``/``size`` and
+         the row verbs are NOT in the set — they are shard-local by
+         construction.)
+
+  PB802  a lifecycle verb (``end_day`` / ``save`` / ``load``) invoked on
+         one member of a subscripted fleet collection
+         (``clients[0].end_day()``, ``servers[k].save(...)``) — the
+         syntactic shape of "I picked one shard of a fleet by hand".
+         Route through a single sharded client (whose methods fan out
+         cluster-wide) instead.
+
+``ps/cluster.py`` (the implementation) and test files are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
+                                               PackageContext)
+
+_SEND_NAMES = ("_call", "_call_attempts")
+_CLUSTER_VERBS = ("end_day", "lifecycle_prepare", "lifecycle_commit",
+                  "lifecycle_abort", "save", "load")
+_MEMBER_VERBS = ("end_day", "save", "load")
+_EXEMPT_PATHS = ("/ps/cluster.py",)
+
+
+def _send_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _frame_verb(node: ast.Call) -> Optional[str]:
+    """The ``"cmd"`` value of the send's request-dict literal (first
+    positional arg), when both are compile-time constants."""
+    if not node.args or not isinstance(node.args[0], ast.Dict):
+        return None
+    for k, v in zip(node.args[0].keys, node.args[0].values):
+        if isinstance(k, ast.Constant) and k.value == "cmd" \
+                and isinstance(v, ast.Constant) \
+                and isinstance(v.value, str):
+            return v.value
+    return None
+
+
+def _receiver_subscripted(func: ast.Attribute) -> bool:
+    """True when the receiver chain picks a collection member:
+    ``clients[0].end_day`` / ``fleet.servers[k].save``."""
+    node = func.value
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Subscript):
+            return True
+        node = node.value
+    return False
+
+
+def check(mod: Module, ctx: PackageContext) -> List[Finding]:
+    path = mod.path.replace("\\", "/")
+    if any(path.endswith(p) for p in _EXEMPT_PATHS) or "/tests/" in path \
+            or mod.basename.startswith("test_"):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _send_name(node.func) in _SEND_NAMES:
+            verb = _frame_verb(node)
+            if verb in _CLUSTER_VERBS:
+                findings.append(Finding(
+                    mod.path, node.lineno, "PB801",
+                    f"hand-built cluster lifecycle frame (cmd={verb!r}): "
+                    "route through the ps/cluster.py helpers "
+                    "(two_phase_lifecycle / cluster_save / cluster_load) "
+                    "— a raw single-shard send invents rids outside the "
+                    "pinned txn group, so a retry after partial failure "
+                    "stops deduplicating and the shards fork"))
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MEMBER_VERBS \
+                and _receiver_subscripted(node.func):
+            findings.append(Finding(
+                mod.path, node.lineno, "PB802",
+                f"lifecycle verb {node.func.attr!r} on one member of a "
+                "fleet collection: with a ServerMap in scope a "
+                "single-shard lifecycle send forks the cluster — call it "
+                "on the sharded client (which fans out 2-phase / through "
+                "the cluster MANIFEST) instead"))
+    return findings

@@ -134,15 +134,17 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 
 def ALL_CHECKERS():
     # local import: checker modules import core for helpers
-    from paddlebox_tpu.tools.pboxlint import (atomic_io, device_cache,
-                                              flags_hygiene, flight_events,
-                                              lifecycle, lockgraph, locks,
-                                              metric_names, purity, retries,
-                                              serving_path, slo_rules)
+    from paddlebox_tpu.tools.pboxlint import (atomic_io, cluster_commit,
+                                              device_cache, flags_hygiene,
+                                              flight_events, lifecycle,
+                                              lockgraph, locks, metric_names,
+                                              purity, retries, serving_path,
+                                              slo_rules)
     return (locks.check, flags_hygiene.check, metric_names.check,
             flight_events.check, purity.check, lifecycle.check,
             retries.check, atomic_io.check, device_cache.check,
-            lockgraph.check, slo_rules.check, serving_path.check)
+            lockgraph.check, slo_rules.check, serving_path.check,
+            cluster_commit.check)
 
 
 def lint_modules(modules: Sequence[Module]) -> List[Finding]:

@@ -19,7 +19,8 @@ Bounded fields are the closed vocabularies of the wire protocol: a verb
 name, a fault site/kind, a role, a configured serving tenant —
 recognized syntactically as a name, attribute or const-subscript whose
 TERMINAL component is one of
-``cmd / verb / site / kind / role / phase / stage / table / tenant`` (e.g.
+``cmd / verb / site / kind / role / phase / stage / table / tenant /
+shard`` (a cluster shard rank is bounded by the fleet size) (e.g.
 ``verb``, ``msg['cmd']``, ``hit.kind``).  Anything else — ``f"k.{key}"``,
 ``"k." + rid`` — is flagged.  A deliberately dynamic name suppresses
 with a reason, like every other rule.
@@ -37,7 +38,7 @@ from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
 _NAME_SINKS = {"stat_add", "stat_observe", "stat_max", "stat_set",
                "stat_get", "span", "start_span"}
 _BOUNDED_FIELDS = {"cmd", "verb", "site", "kind", "role", "phase",
-                   "stage", "table", "tenant"}
+                   "stage", "table", "tenant", "shard"}
 _LITERAL_OK = re.compile(r"[a-z0-9_.]*\Z")
 
 

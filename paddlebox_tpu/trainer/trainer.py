@@ -139,6 +139,12 @@ class SparseTrainer:
         self._step_fn = None
         self._packed_step_fn = None
         self._packed_sig = None
+        # set by the step builders, cleared by the first dispatch after a
+        # (re)build: jax.jit traces+compiles on that call, so its latency
+        # is compile cost, not steady-state dispatch — it gets its own
+        # metric (trainer.step_compile_s) to keep the SLO throughput-stall
+        # rule and the dispatch p99 on steady-state numbers only
+        self._compile_pending = False
         self._mxu_crossing = ("take", "take")
         self._check_nan = flags.get_flags("check_nan_inf")
 
@@ -304,6 +310,7 @@ class SparseTrainer:
                         dense, labels, valid, None, extras)
 
         self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        self._compile_pending = True
 
     def _pooled_dense_half(self):
         """Shared back half of the pooled-based steps (mxu/fast): dense
@@ -797,6 +804,7 @@ class SparseTrainer:
                         bt["valid"], plan, extras)
 
         self._packed_step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        self._compile_pending = True
         # n_rows + feed geometry drive retrace via shapes, but the plan
         # presence/path/async/crossing flags are trace-structural — key them
         self._packed_sig = sig
@@ -868,9 +876,14 @@ class SparseTrainer:
                 intervals.record("device", m_step, time.monotonic())
                 # per-batch dispatch latency distribution (the loss
                 # readback below is the sync point, so this is dispatch
-                # cost, not device step time)
-                stat_observe("trainer.step_dispatch_s",
-                             time.perf_counter() - t_step)
+                # cost, not device step time); the first dispatch after a
+                # (re)build is jit compile — its own metric
+                dt_step = time.perf_counter() - t_step
+                if self._compile_pending:
+                    self._compile_pending = False
+                    stat_observe("trainer.step_compile_s", dt_step)
+                else:
+                    stat_observe("trainer.step_dispatch_s", dt_step)
                 if async_dense:
                     (ws, params, opt_state, auc_state, loss, preds,
                      d_params) = out
@@ -1067,9 +1080,15 @@ class SparseTrainer:
                 intervals.record("device", m_step, time.monotonic())
                 # same per-batch dispatch distribution as the packed loop:
                 # the SLO watchdog's throughput-stall rule rates this
-                # counter, so BOTH train paths must feed it
-                stat_observe("trainer.step_dispatch_s",
-                             time.perf_counter() - t_step)
+                # counter, so BOTH train paths must feed it — and both
+                # route the first post-build dispatch (jit compile) to
+                # trainer.step_compile_s instead
+                dt_step = time.perf_counter() - t_step
+                if self._compile_pending:
+                    self._compile_pending = False
+                    stat_observe("trainer.step_compile_s", dt_step)
+                else:
+                    stat_observe("trainer.step_dispatch_s", dt_step)
                 if self.async_dense is not None:
                     (ws, params, opt_state, auc_state, loss, preds,
                      d_params) = out

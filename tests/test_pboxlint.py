@@ -1146,3 +1146,107 @@ def test_pb701_non_serving_module_out_of_scope():
             self.table.bulk_write(req["keys"], req["rows"])
     """
     assert "PB701" not in serving_codes(src, path="ps/other.py")
+
+
+# -- PB8xx PS-cluster commit discipline ---------------------------------------
+
+def test_pb801_hand_built_lifecycle_frame():
+    src = """
+    def roll_day(client):
+        client._call({"cmd": "end_day", "table": None}, dedup=True)
+    """
+    assert codes(src) == ["PB801"]
+
+
+def test_pb801_hand_built_commit_frame():
+    src = """
+    def finish(client, group):
+        client._call({"cmd": "lifecycle_commit", "verb": "end_day",
+                      "txn": group}, shard=0)
+    """
+    assert codes(src) == ["PB801"]
+
+
+def test_pb801_save_load_frames():
+    src = """
+    def snap(client, path):
+        client._call({"cmd": "save", "path": path, "mode": "all"})
+        client._call_attempts({"cmd": "load", "path": path}, attempts=2)
+    """
+    assert codes(src) == ["PB801", "PB801"]
+
+
+def test_pb801_shard_local_verbs_ok():
+    # shrink/size/row verbs are shard-local by construction — not in scope
+    src = """
+    def stats(client):
+        client._call({"cmd": "size", "table": None})
+        client._call({"cmd": "shrink", "threshold": 0.1})
+        client._call({"cmd": "pull_sparse_chunk", "keys": keys})
+    """
+    assert codes(src) == []
+
+
+def test_pb801_dynamic_cmd_out_of_scope():
+    # a verb that is not a compile-time constant is someone else's
+    # dispatch layer (the 2-phase helper itself builds frames this way)
+    src = """
+    def send(client, verb):
+        client._call({"cmd": verb, "table": None})
+    """
+    assert codes(src) == []
+
+
+def test_pb801_cluster_impl_and_tests_exempt():
+    src = """
+    def two_phase(client):
+        client._call({"cmd": "lifecycle_prepare", "verb": "end_day"})
+    """
+    assert codes(src, path="paddlebox_tpu/ps/cluster.py") == []
+    assert codes(src, path="tests/test_ps_cluster.py") == []
+
+
+def test_pb802_member_lifecycle_send():
+    src = """
+    def roll(clients):
+        clients[0].end_day()
+    """
+    assert codes(src) == ["PB802"]
+
+
+def test_pb802_member_save_through_attribute_chain():
+    src = """
+    def snap(fleet, path):
+        fleet.servers[1].save(path, mode="all")
+    """
+    assert codes(src) == ["PB802"]
+
+
+def test_pb802_unsubscripted_receiver_ok():
+    # the sharded client's own methods fan out cluster-wide — calling
+    # them on a plain receiver is exactly the sanctioned route
+    src = """
+    def roll(client, path):
+        client.end_day()
+        client.save(path, mode="all")
+        engine.table.end_day()
+    """
+    assert codes(src) == []
+
+
+def test_pb802_non_lifecycle_member_calls_ok():
+    src = """
+    def pump(self, shard):
+        self._free[shard].pop()
+        self.jobs[shard].run()
+    """
+    assert codes(src) == []
+
+
+def test_pb801_suppression_escape():
+    src = """
+    def probe(client):
+        # pboxlint: disable-next=PB801 -- single-server probe harness
+        client._call({"cmd": "end_day", "table": None})
+    """
+    assert codes(src) == []
