@@ -721,7 +721,7 @@ def _cluster_bench(tag):
 
     import subprocess
 
-    from paddlebox_tpu.ps.cluster import ServerMap
+    from paddlebox_tpu.ps.cluster import make_server_map
     from paddlebox_tpu.ps.service import PSClient
     from paddlebox_tpu.utils.monitor import stat_snapshot
 
@@ -806,7 +806,7 @@ def _cluster_bench(tag):
 
     def drive_wide():
         procs, addrs = spawn(n_wide)
-        smap = ServerMap(addrs)
+        smap = make_server_map(addrs)
         fan = None
         per_shard = []
         try:
@@ -849,6 +849,160 @@ def _cluster_bench(tag):
             "wire_speedup": round(
                 one["wall_s"] / max(wide["critical_path_s"], 1e-9), 2),
             "slowest_shard_stall_s": round(stall, 4)}
+
+
+def _reshard_bench(tag):
+    """Elastic-membership phase: grow a live N=2 PS fleet to N=4 by the
+    ps/reshard.py key-range handoff while zipf read+write traffic keeps
+    flowing against the NON-moving key range, and measure what the
+    migration actually costs the fleet:
+
+      cutover_stall_ms    — freeze-to-commit window (the only interval
+                            where moving-range writes block)
+      moved_rows_per_s    — snapshot + delta shipping rate
+      nonmoving_qps_drop  — fractional traffic-rate drop during the
+                            migration vs the pre-migration baseline;
+                            the graceful-degradation claim is that
+                            non-moving shards keep serving, so this
+                            should stay near 0
+
+    Real server processes (same reasons as _cluster_bench), old members
+    started epoch-0 legacy (the production bootstrap shape: a fleet that
+    never resharded), new members started PENDING (``--shard -1`` with
+    the old membership — they answer typed redirects until the cutover
+    admits them).  The traffic client discovers the cutover organically
+    through wrong_epoch redirects — the same path production clients
+    take — so the qps trace also covers the refresh-and-re-drive cost."""
+
+    import subprocess
+    import tempfile
+
+    from paddlebox_tpu.ps import cluster as ps_cluster
+    from paddlebox_tpu.ps.reshard import reshard
+    from paddlebox_tpu.ps.service import PSClient
+    from paddlebox_tpu.utils.monitor import stat_snapshot
+
+    n_keys = int(os.environ.get("BENCH_RESHARD_KEYS", 200_000))
+    n_old = int(os.environ.get("BENCH_RESHARD_OLD", 2))
+    n_new = int(os.environ.get("BENCH_RESHARD_NEW", 4))
+    batch = int(os.environ.get("BENCH_RESHARD_BATCH", 50_000))
+    warm_s = float(os.environ.get("BENCH_RESHARD_WARM_S", 2.0))
+    mf_dim = 8
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    def spawn(n, extra=()):
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "paddlebox_tpu.ps.server_main",
+             "--port", "0", "--mf_dim", str(mf_dim), "--seed", "5",
+             *extra],
+            cwd=repo, env=env, stdout=subprocess.PIPE, text=True)
+            for _ in range(n)]
+        addrs = []
+        for p in procs:
+            line = p.stdout.readline().strip()
+            host, _, port = line.rpartition(" ")[2].rpartition(":")
+            addrs.append((host, int(port)))
+        return procs, addrs
+
+    def reap(procs):
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    set_phase(f"{tag}:reshard[spawn]", 120)
+    old_procs, old_addrs = spawn(n_old)
+    new_procs = []
+    client = None
+    stop = threading.Event()
+    samples = []                        # (t_done, keys) per traffic round
+    errors = []
+    try:
+        client = PSClient(old_addrs, retries=None, deadline=120)
+        rng = np.random.default_rng(29)
+        universe = rng.choice(2 ** 40, n_keys,
+                              replace=False).astype(np.uint64)
+        set_phase(f"{tag}:reshard[seed]", 300)
+        client.pull_sparse(universe, create=True)   # materialize rows
+
+        new_procs, grown = spawn(
+            n_new - n_old,
+            extra=("--membership", ps_cluster.format_addrs(old_addrs),
+                   "--epoch", "0", "--shard", "-1"))
+        union = list(old_addrs) + grown
+        old_map = client.server_map
+        target = ps_cluster.make_server_map(union)   # partition preview
+        moving = (target.shard_of_keys(universe)
+                  != old_map.shard_of_keys(universe))
+        stay = universe[~moving]
+        blocks = [np.unique(stay[
+            np.minimum(rng.zipf(1.3, size=batch), len(stay)) - 1])
+            for _ in range(8)]
+
+        def traffic():
+            cl = PSClient(old_addrs, retries=None, retry_sleep=0.02,
+                          backoff_cap=0.25, deadline=60)
+            try:
+                i = 0
+                while not stop.is_set():
+                    b = blocks[i % len(blocks)]
+                    rows = cl.pull_sparse(b)
+                    cl.push_sparse(b, rows)
+                    samples.append((time.perf_counter(), 2 * len(b)))
+                    i += 1
+            except Exception as e:      # noqa: BLE001 — reported below
+                errors.append(e)
+            finally:
+                cl.close()
+
+        t_start = time.perf_counter()
+        pump = threading.Thread(target=traffic, name="reshard-traffic",
+                                daemon=True)
+        pump.start()
+        time.sleep(warm_s)              # pre-migration qps baseline
+
+        set_phase(f"{tag}:reshard[migrate {n_old}->{n_new}]", 300)
+        workdir = tempfile.mkdtemp(prefix="bench-reshard-")
+        t0 = time.perf_counter()
+        reshard(client, union, workdir, rounds=2, timeout=120)
+        t1 = time.perf_counter()
+        time.sleep(min(warm_s, 1.0))    # post-cutover redirect recovery
+        stop.set()
+        pump.join(timeout=60)
+        if errors:
+            raise errors[0]
+
+        def rate(lo, hi):
+            keys = sum(k for t, k in samples if lo <= t < hi)
+            return keys / max(hi - lo, 1e-9)
+
+        qps_before = rate(t_start + 0.25, t0)
+        qps_during = rate(t0, t1)
+        drop = max(0.0, 1.0 - qps_during / max(qps_before, 1e-9))
+        snap = stat_snapshot("ps.reshard.")
+        moved = float(snap.get("ps.reshard.rows_moved", 0.0))
+        stall = float(snap.get("ps.reshard.cutover_stall_ms.max", 0.0))
+        return {"cutover_stall_ms": round(stall, 2),
+                "moved_rows_per_s": round(moved / max(t1 - t0, 1e-9)),
+                "nonmoving_qps_drop": round(drop, 4),
+                "moved_rows": int(moved),
+                "migrate_s": round(t1 - t0, 3),
+                "qps_before": round(qps_before),
+                "qps_during": round(qps_during),
+                "epoch": int(client.server_map.epoch),
+                "n_old": n_old, "n_new": n_new, "keys": n_keys}
+    finally:
+        stop.set()
+        if client is not None:
+            client.close()
+        reap(old_procs + new_procs)
 
 
 def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
@@ -1119,9 +1273,29 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
         except Exception as e:  # phase is diagnostic, never fatal
             trace(f"{tag}: cluster bench failed: {type(e).__name__}: {e}")
 
+    reshard = {}
+    if tag == "full" and not legacy \
+            and os.environ.get("BENCH_RESHARD", "1") == "1":
+        set_phase(f"{tag}:reshard", 600)
+        try:
+            reshard = _reshard_bench(tag)
+            record(reshard_stall_ms=reshard["cutover_stall_ms"],
+                   reshard_qps_drop=reshard["nonmoving_qps_drop"])
+            trace(f"{tag}: reshard {reshard['n_old']}->{reshard['n_new']} "
+                  f"moved {reshard['moved_rows']:,} rows "
+                  f"({reshard['moved_rows_per_s']:,}/s) "
+                  f"cutover_stall={reshard['cutover_stall_ms']:.1f}ms "
+                  f"nonmoving_qps_drop={reshard['nonmoving_qps_drop']:.3f}")
+            if reshard["nonmoving_qps_drop"] > 0.5:
+                trace(f"{tag}: WARNING non-moving traffic lost more than "
+                      "half its rate during the live handoff")
+        except Exception as e:  # phase is diagnostic, never fatal
+            trace(f"{tag}: reshard bench failed: {type(e).__name__}: {e}")
+
     return {"e2e": e2e_eps, "device_step": device_eps,
             "pass_cycle": pass_cycle, "recovery": recovery,
             "cache": cache_cmp, "serving": serving, "cluster": cluster,
+            "reshard": reshard,
             "batches": int(stats["batches"]), "examples": int(n_examples),
             "auc": round(float(stats.get("auc", float("nan"))), 4),
             "compile_s": round(compile_s, 1), "pass_pack_s": round(pack_s, 1),
@@ -1211,7 +1385,7 @@ def run() -> None:
          feed_gap_ratio=full["feed_gap_ratio"],
          pass_cycle=full["pass_cycle"], recovery=full["recovery"],
          cache=full["cache"], serving=full["serving"],
-         cluster=full["cluster"],
+         cluster=full["cluster"], reshard=full["reshard"],
          feed_intervals=full["feed_intervals"], timers=full["timers"],
          timeline=_timeline_summary(), obs_stats=_obs_snapshot())
 
@@ -1570,7 +1744,10 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
         pfrac = (pn - po) / po
         out["serving_p99_ms"] = {"old": po, "new": pn,
                                  "delta_frac": round(pfrac, 4)}
-        if pfrac > threshold:
+        # one 200-batch sample of a sub-ms p99 on a contended CPU host
+        # swings ±20% run to run (r09 1.05 / r10 0.90 / r11 1.07) — gate
+        # only when the growth clears an absolute floor too
+        if pfrac > threshold and (pn - po) > 0.25:
             regressions.append(
                 f"serving.p99_ms {po:.2f} -> {pn:.2f} ({pfrac:+.1%})")
     sho, shn = num(svo, "shed_rate") or 0.0, num(svn, "shed_rate")
@@ -1589,6 +1766,38 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
             regressions.append(
                 f"cluster.wire_speedup {clo:.2f}x -> {cln:.2f}x "
                 f"({clfrac:+.1%})")
+    reo, ren = old.get("reshard") or {}, new.get("reshard") or {}
+    rmo, rmn = num(reo, "moved_rows_per_s"), num(ren, "moved_rows_per_s")
+    if rmo and rmn is not None:         # slower row shipping = regression
+        rmfrac = (rmn - rmo) / rmo
+        out["reshard_moved_rows_per_s"] = {"old": rmo, "new": rmn,
+                                           "delta_frac": round(rmfrac, 4)}
+        if rmfrac < -threshold:
+            regressions.append(
+                f"reshard.moved_rows_per_s {rmo:.0f} -> {rmn:.0f} "
+                f"({rmfrac:+.1%})")
+    rso, rsn = num(reo, "cutover_stall_ms"), num(ren, "cutover_stall_ms")
+    if rso and rsn is not None:         # longer freeze window = regression
+        # the stall is one freeze→commit interval measured once, so CPU
+        # scheduling noise dominates small deltas — gate only on a
+        # half-again growth, never on the plain threshold
+        rsfrac = (rsn - rso) / rso
+        out["reshard_cutover_stall_ms"] = {"old": rso, "new": rsn,
+                                           "delta_frac": round(rsfrac, 4)}
+        if rsfrac > max(threshold, 0.5):
+            regressions.append(
+                f"reshard.cutover_stall_ms {rso:.1f} -> {rsn:.1f} "
+                f"({rsfrac:+.1%})")
+    rdo = num(reo, "nonmoving_qps_drop")
+    rdn = num(ren, "nonmoving_qps_drop")
+    if rdn is not None:                 # non-moving traffic newly stalling
+        # a drop gate needs a same-basis baseline: the first round that
+        # records the reshard phase only reports (rdo None — the old
+        # record predates the phase, NOT a zero-drop measurement)
+        out["reshard_nonmoving_qps_drop"] = {"old": rdo, "new": rdn}
+        if rdo is not None and rdn > rdo + 0.10:
+            regressions.append(
+                f"reshard.nonmoving_qps_drop {rdo:.3f} -> {rdn:.3f}")
     mo = num(old.get("recovery") or {}, "mttr_s")
     mn = num(new.get("recovery") or {}, "mttr_s")
     if mo and mn is not None:           # slower recovery = regression

@@ -119,7 +119,10 @@ class TrainCheckpoint:
         if not os.path.exists(path):
             return None
         with open(path) as f:
-            return int(json.load(f)["generation"])
+            gen = json.load(f).get("generation")
+        # a membership-only manifest (commit_membership before any
+        # checkpoint ever committed) carries generation: null
+        return None if gen is None else int(gen)
 
     def _state(self, n: int) -> Dict:
         with open(os.path.join(self._gen_dir(n), "STATE.json")) as f:
@@ -219,12 +222,13 @@ class TrainCheckpoint:
         # client's save fan-out, ps/cluster.cluster_save) and THIS
         # MANIFEST advance below is the single cluster-wide commit point
         # naming all N shard heads at once
-        n_shards = getattr(getattr(engine.table, "server_map", None),
-                           "n", 1)
+        smap = getattr(engine.table, "server_map", None)
+        n_shards = getattr(smap, "n", 1)
         state = {"generation": gen, "kind": kind, "chain": chain,
                  "day_id": engine.day_id, "pass_id": engine.pass_id,
                  "phase": engine.phase, "rows": int(rows),
-                 "shards": int(n_shards)}
+                 "shards": int(n_shards),
+                 "ps_epoch": int(getattr(smap, "epoch", 0) or 0)}
         if extra:
             state.update(extra)
         with open(os.path.join(tmpdir, "STATE.json"), "w") as f:
@@ -240,9 +244,15 @@ class TrainCheckpoint:
             # the crash window the MANIFEST swap closes: generation dir
             # complete, pointer not yet advanced → old generation loads
             faults.on_lifecycle("ckpt_commit")
+        man = {"generation": gen, "shards": int(n_shards)}
+        if smap is not None and getattr(smap, "epoch", 0):
+            # elastic fleet: the manifest names the committed membership
+            # alongside the generation head — a restart reads BOTH from
+            # one atomically-swapped pointer (ps/reshard.py rollback)
+            man["ps_epoch"] = int(smap.epoch)
+            man["ps_addrs"] = [[h, int(p)] for h, p in smap.addrs]
         _atomic_write(os.path.join(self.root, MANIFEST),
-                      json.dumps({"generation": gen,
-                                  "shards": int(n_shards)}).encode())
+                      json.dumps(man).encode())
         dt = time.monotonic() - t0
         stat_observe("ckpt.save_s", dt)
         stat_set("ckpt.generation", float(gen))
@@ -345,6 +355,49 @@ class TrainCheckpoint:
         flight.record("resume_ok", generation=head,
                       pass_id=engine.pass_id, restore_s=round(dt, 3))
         return state
+
+
+def commit_membership(root: str, server_map) -> bool:
+    """Record a committed PS membership (epoch + addresses) in the
+    checkpoint MANIFEST — the durable half of the reshard cutover
+    (ps/reshard.py phase 5).  Atomic pointer swap like every MANIFEST
+    advance: a crash before this call leaves the OLD membership in the
+    manifest, and a restart resharding-on-load against it is the whole
+    rollback story.  Epoch-guarded (a stale/duplicate commit no-ops);
+    preserves the generation head untouched.  Returns True when the
+    manifest advanced."""
+    os.makedirs(root, exist_ok=True)
+    path = os.path.join(root, MANIFEST)
+    man: Dict = {"generation": None}
+    if os.path.exists(path):
+        with open(path) as f:
+            man = json.load(f)
+    if int(man.get("ps_epoch", 0)) >= int(server_map.epoch):
+        return False
+    man["ps_epoch"] = int(server_map.epoch)
+    man["ps_addrs"] = [[h, int(p)] for h, p in server_map.addrs]
+    man["shards"] = int(server_map.n)
+    _atomic_write(path, json.dumps(man).encode())
+    flight.record("membership_commit", epoch=int(server_map.epoch),
+                  shards=int(server_map.n))
+    return True
+
+
+def read_membership(root: str):
+    """The committed PS membership from ``<root>/MANIFEST.json`` as a
+    ServerMap, or None when the manifest is absent or membership-less
+    (pre-elastic checkpoints)."""
+    from paddlebox_tpu.ps import cluster as ps_cluster
+    path = os.path.join(root, MANIFEST)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        man = json.load(f)
+    addrs = man.get("ps_addrs")
+    if not addrs:
+        return None
+    return ps_cluster.make_server_map([tuple(a) for a in addrs],
+                                      epoch=int(man.get("ps_epoch", 0)))
 
 
 def save_xbox(engine: BoxPSEngine, path: str, base: bool = True) -> int:

@@ -499,7 +499,8 @@ class PSServerSupervisor:
                  max_restarts: int = 8, backoff_base: float = 0.05,
                  backoff_cap: float = 1.0, ckpt_root: Optional[str] = None,
                  reload_from_ckpt: bool = False, poll_s: float = 0.02,
-                 shard: Optional[int] = None):
+                 shard: Optional[int] = None, membership=None,
+                 cluster_shard: Optional[int] = None):
         from paddlebox_tpu.ps.service import PSServer
         self._make = PSServer
         self.table = table
@@ -514,7 +515,12 @@ class PSServerSupervisor:
         self._backoff = (backoff_base, backoff_cap)
         self._poll_s = poll_s
         self._stop = threading.Event()
-        self.server = PSServer(table, host=host, port=port)
+        # ``membership`` (a ServerMap) turns on epoch fencing;
+        # ``cluster_shard`` is the server's index in it (-1 = pending
+        # member awaiting a reshard cutover).  Defaults to ``shard``.
+        cs = cluster_shard if cluster_shard is not None else (shard or 0)
+        self.server = PSServer(table, host=host, port=port,
+                               membership=membership, shard=cs)
         self.port = self.server.addr[1]
         self._watch = threading.Thread(target=self._run,
                                        name="pbox-ps-supervisor",
@@ -554,9 +560,14 @@ class PSServerSupervisor:
         attempt = 0
         while not self._stop.is_set():
             try:
+                # the dying instance's membership may be AHEAD of what
+                # this supervisor was constructed with (a reshard cutover
+                # adopted a newer epoch) — carry the latest forward
                 self.server = self._make(self.table, host=self.host,
                                          port=self.port,
-                                         dedup_state=dedup)
+                                         dedup_state=dedup,
+                                         membership=old.membership,
+                                         shard=old.shard)
                 break
             except OSError:
                 # the dead listener's port may still be draining
@@ -611,16 +622,46 @@ class PSFleet:
         if n < 1:
             raise ValueError("PSFleet needs n >= 1 servers")
         cfg = config or EmbeddingTableConfig(embedding_dim=mf_dim)
+        self._cfg = cfg
+        self._seed = seed
+        self._host = host
+        self._port_base = port_base
+        self._ckpt_root = ckpt_root
+        self._max_restarts = max_restarts
+        # pboxlint: disable-next=PB803 -- fleet-level epoch mirror, not a ServerMap
+        self.epoch = 0
         self.n = n
-        self.sups = [PSServerSupervisor(
-            ShardedHostTable(cfg, seed=seed),
-            host=host,
-            port=(port_base + k) if port_base else 0,
+        self.sups = [self._spawn(k, n, reload_from_ckpt)
+                     for k in range(n)]
+        # retired (shrunk-away) supervisors stay up for a grace period
+        # answering typed redirects + chunk-fate probes, then reap
+        self._retired: List = []        # (mono_deadline, supervisor)
+        self._apply_membership()
+
+    def _spawn(self, k: int, n: int, reload_from_ckpt: bool = False,
+               pending: bool = False):
+        from paddlebox_tpu.ps.host_table import ShardedHostTable
+        return PSServerSupervisor(
+            ShardedHostTable(self._cfg, seed=self._seed),
+            host=self._host,
+            port=(self._port_base + k) if self._port_base else 0,
             shard=(k if n > 1 else None),
-            ckpt_root=ckpt_root,
+            cluster_shard=(-1 if pending else k),
+            ckpt_root=self._ckpt_root,
             reload_from_ckpt=reload_from_ckpt,
-            max_restarts=max_restarts)
-            for k in range(n)]
+            max_restarts=self._max_restarts)
+
+    def _apply_membership(self) -> None:
+        """Stamp the fleet's current ServerMap onto every member — the
+        addresses are only all known once every server has bound, so
+        membership lands right after construction (and after every
+        resize), before any worker client connects."""
+        from paddlebox_tpu.ps import cluster as ps_cluster
+        m = ps_cluster.make_server_map(self.addrs, epoch=self.epoch)
+        for k, s in enumerate(self.sups):
+            s.server.membership = m
+            s.server.shard = k
+            s.shard = k if self.n > 1 else None
 
     @property
     def addrs(self):
@@ -630,9 +671,163 @@ class PSFleet:
         from paddlebox_tpu.ps import cluster as ps_cluster
         return ps_cluster.format_addrs(self.addrs)
 
+    def resize(self, new_n: int, workdir: str, *, rounds: int = 2,
+               settle_rows: int = 0, timeout: float = 120.0,
+               retire_grace: float = 5.0) -> None:
+        """Live-resize the fleet to ``new_n`` shards via the key-range
+        handoff (ps/reshard.py): grow spawns pending members first
+        (``shard=-1`` — they answer typed redirects until the cutover
+        admits them); shrink retires the tail AFTER the cutover, keeping
+        the retirees up for ``retire_grace`` seconds so late clients
+        still draw redirects instead of connection errors.  Serving
+        continues throughout; only the moving key range blocks, briefly,
+        at the freeze."""
+        from paddlebox_tpu.ps import cluster as ps_cluster
+        from paddlebox_tpu.ps import reshard as ps_reshard
+        from paddlebox_tpu.ps.service import PSClient
+        new_n = int(new_n)
+        if new_n < 1:
+            raise ValueError("PSFleet.resize needs new_n >= 1")
+        if new_n == self.n:
+            return
+        grown = []
+        if new_n > self.n:
+            grown = [self._spawn(k, new_n, pending=True)
+                     for k in range(self.n, new_n)]
+            m = ps_cluster.make_server_map(self.addrs, epoch=self.epoch)
+            for s in grown:
+                s.server.membership = m
+        new_addrs = self.addrs + [s.addr for s in grown] \
+            if grown else self.addrs[:new_n]
+        drv = PSClient(self.addrs, retries=None, deadline=timeout)
+        try:
+            drv._adopt_map(ps_cluster.make_server_map(
+                self.addrs, epoch=self.epoch))
+            new_map = ps_reshard.reshard(
+                drv, new_addrs, workdir, rounds=rounds,
+                settle_rows=settle_rows, timeout=timeout,
+                manifest_root=self._ckpt_root)
+        except BaseException:
+            for s in grown:
+                s.stop()
+            raise
+        finally:
+            drv.close()
+        now = time.monotonic()
+        if new_n > self.n:
+            self.sups = self.sups + grown
+        else:
+            self._retired += [(now + retire_grace, s)
+                              for s in self.sups[new_n:]]
+            self.sups = self.sups[:new_n]
+        self.n = new_n
+        # pboxlint: disable-next=PB803 -- fleet-level epoch mirror, not a ServerMap
+        self.epoch = new_map.epoch
+        for k, s in enumerate(self.sups):
+            s.shard = k if new_n > 1 else None
+        flight.record("ps_fleet_resize", n=new_n, epoch=self.epoch)
+
+    def reap_retired(self, force: bool = False) -> None:
+        """Stop retired supervisors whose grace elapsed (all, when
+        ``force``)."""
+        now = time.monotonic()
+        keep = []
+        for deadline, s in self._retired:
+            if force or now >= deadline:
+                s.stop()
+            else:
+                keep.append((deadline, s))
+        self._retired = keep
+
     def stop(self) -> None:
+        self.reap_retired(force=True)
         for s in self.sups:
             s.stop()
+
+
+class PSElasticWatcher:
+    """``--ps_elastic DIR``: honor live fleet-resize requests.
+
+    Drop a positive integer into ``<dir>/ps_grow`` (servers to add) or
+    ``<dir>/ps_shrink`` (servers to remove; the fleet never shrinks
+    below 1) and the watcher drives :meth:`PSFleet.resize` — snapshot,
+    delta catch-up, freeze, epoch-bumped cutover — then re-exports
+    ``PBOX_PS_ADDRS`` for future worker generations (live workers
+    discover the new map through typed redirects + the health probe
+    fall-through, no restart needed).  Requests are consumed
+    best-effort: a malformed file is eaten and logged; a failed resize
+    is rolled back by the driver (the fleet keeps serving the old
+    epoch) and the request is dropped rather than retried forever."""
+
+    def __init__(self, fleet: PSFleet, elastic_dir: str, workroot: str,
+                 poll_s: float = 0.5, retire_grace: float = 5.0,
+                 rounds: int = 2, timeout: float = 120.0):
+        os.makedirs(elastic_dir, exist_ok=True)
+        self.fleet = fleet
+        self.dir = elastic_dir
+        self.workroot = workroot
+        self.retire_grace = retire_grace
+        self.rounds = rounds
+        self.timeout = timeout
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="pbox-ps-elastic",
+                                        daemon=True)
+        self._thread.start()
+
+    def _consume(self, name: str) -> int:
+        """Read-and-unlink ``<dir>/<name>``; 0 when absent/malformed
+        (a bad request must not be re-parsed every poll)."""
+        path = os.path.join(self.dir, name)
+        try:
+            with open(path) as f:
+                raw = f.read().strip()
+        except OSError:
+            return 0
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            print(f"[ps-elastic] ignoring malformed {name}: {raw!r}",
+                  file=sys.stderr)
+            return 0
+
+    def _resize(self, target: int) -> None:
+        workdir = os.path.join(self.workroot,
+                               f"reshard-e{self.fleet.epoch + 1}")
+        try:
+            self.fleet.resize(target, workdir, rounds=self.rounds,
+                              timeout=self.timeout,
+                              retire_grace=self.retire_grace)
+        except Exception as e:
+            print(f"[ps-elastic] resize to {target} failed "
+                  f"(fleet keeps serving epoch {self.fleet.epoch}): {e}",
+                  file=sys.stderr)
+            return
+        from paddlebox_tpu.ps import cluster as ps_cluster
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ[ps_cluster.ADDRS_ENV] = self.fleet.env_value()
+        print(f"[ps-elastic] fleet now n={self.fleet.n} "
+              f"epoch={self.fleet.epoch}", file=sys.stderr)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            grow = self._consume("ps_grow")
+            if grow:
+                self._resize(self.fleet.n + grow)
+            shrink = self._consume("ps_shrink")
+            if shrink:
+                self._resize(max(1, self.fleet.n - shrink))
+            self.fleet.reap_retired()
+            self._stop.wait(self._poll_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30.0)
 
 
 class ServingReplicaSupervisor:
@@ -904,6 +1099,19 @@ def main():
                     help="PS fleet fresh-row seed; all shards share it "
                          "(defaults are pure in (seed, key), so the "
                          "cluster key space is consistent)")
+    ap.add_argument("--ps_elastic", default="",
+                    help="watch DIR/ps_grow and DIR/ps_shrink for live "
+                         "fleet-resize requests (integer = servers to "
+                         "add/remove) and drive the key-range handoff "
+                         "(ps/reshard.py) without stopping training; "
+                         "PBOX_PS_ADDRS is re-exported after each "
+                         "cutover.  '' = off")
+    ap.add_argument("--ps_reshard_rounds", type=int, default=2,
+                    help="delta catch-up rounds before the reshard "
+                         "freeze (>= 1)")
+    ap.add_argument("--ps_retire_grace", type=float, default=5.0,
+                    help="seconds a shrunk-away PS server keeps "
+                         "answering typed redirects before it stops")
     ap.add_argument("--serve", type=int, default=0,
                     help="run N supervised read-only serving replicas "
                          "(ps/serving.py) instead of training workers; "
@@ -1012,6 +1220,15 @@ def main():
         os.environ[_ps_cluster.ADDRS_ENV] = ps_fleet.env_value()
         for k, (h, p) in enumerate(ps_fleet.addrs):
             print(f"[ps] shard {k} {h}:{p}", file=sys.stderr)
+    ps_watcher = None
+    if args.ps_elastic:
+        if ps_fleet is None:
+            ap.error("--ps_elastic needs --ps_servers")
+        ps_watcher = PSElasticWatcher(
+            ps_fleet, args.ps_elastic,
+            workroot=os.path.join(args.ps_elastic, "reshard"),
+            retire_grace=args.ps_retire_grace,
+            rounds=max(1, args.ps_reshard_rounds))
     proxy = None
     if args.chaos_backend:
         from paddlebox_tpu.ps.faults import ChaosProxy, FaultPlan
@@ -1040,6 +1257,8 @@ def main():
     finally:
         if proxy is not None:
             proxy.shutdown()
+        if ps_watcher is not None:
+            ps_watcher.stop()
         if ps_fleet is not None:
             ps_fleet.stop()
     sys.exit(rc)

@@ -32,6 +32,23 @@ Checkpoint fan-out: `cluster_save`/`cluster_load` write/read per-shard
 subdirs live inside one generation tmpdir, the PR 8 tmp+rename commit and
 the single cluster MANIFEST atomically advance ALL shards together —
 crash recovery rolls every shard back to the same generation.
+
+Elastic membership (ps/reshard.py) adds a monotonic **epoch** to the map:
+every fenced sparse verb carries its client's epoch, a server whose
+membership disagrees answers a typed ``wrong_epoch`` / ``not_owner`` /
+``migrating`` rejection (never silently applying to a range it no longer
+owns), and the client refreshes its map from the fleet's ``health``
+surface (shard 0 preferred, falling through dead entries) and re-drives
+only the affected chunks through the dedup window.  Membership changes
+MUST route through the reshard API — pboxlint PB803 flags hand-built
+``ServerMap`` construction or ``addrs``/``epoch`` mutation anywhere else
+(:func:`make_server_map` is the sanctioned constructor for client code).
+
+``cluster_load`` reshards on load: when the on-disk dump width differs
+from the fleet width (an N=4 dump restoring into an N=2 fleet), every
+fleet shard walks ALL source subdirs server-side and keeps only the keys
+that hash to itself — the offline fallback when a live handoff isn't
+wanted.
 """
 
 from __future__ import annotations
@@ -57,7 +74,9 @@ CLUSTER_SALT = 0x9E2A5C7B3D41F68D
 ADDRS_ENV = "PBOX_PS_ADDRS"
 
 # lifecycle verbs legal inside a 2-phase cluster transaction
-LIFECYCLE_VERBS = ("end_day",)
+# (reshard_cutover = the membership flip: commit adopts the staged/carried
+#  new map, drops moved rows on the sources, and unfreezes the moving range)
+LIFECYCLE_VERBS = ("end_day", "reshard_cutover")
 
 
 def shard_dir(path: str, shard: int) -> str:
@@ -94,13 +113,24 @@ class ServerMap:
     same shard for every client of the same fleet size.
     """
 
-    __slots__ = ("addrs", "n")
+    __slots__ = ("addrs", "n", "epoch")
 
-    def __init__(self, addrs: Sequence[Tuple[str, int]]):
+    def __init__(self, addrs: Sequence[Tuple[str, int]], epoch: int = 0):
         if not addrs:
             raise ValueError("ServerMap needs at least one server address")
         self.addrs: List[Tuple[str, int]] = [tuple(a) for a in addrs]
         self.n = len(self.addrs)
+        # monotonic membership epoch: bumped by exactly one on every
+        # committed reshard; fenced sparse verbs carry it so a server
+        # whose membership disagrees can answer a typed redirect instead
+        # of silently applying to a range it doesn't own
+        self.epoch = int(epoch)
+
+    def describe(self) -> Dict:
+        """Wire-shaped membership descriptor (health / redirect hint).
+        Addresses ride as the compact ``format_addrs`` string — the wire
+        codec carries scalars and flat dicts, not nested lists."""
+        return {"epoch": self.epoch, "addrs": format_addrs(self.addrs)}
 
     def shard_of_keys(self, keys: np.ndarray) -> np.ndarray:
         """Vectorized shard id per key (int64; all zeros when n == 1)."""
@@ -122,6 +152,40 @@ class ServerMap:
         """
         shards = self.shard_of_keys(keys)
         return [np.flatnonzero(shards == s) for s in range(self.n)]
+
+
+def owned_mask(keys: np.ndarray, shard: int, n: int) -> np.ndarray:
+    """Boolean mask of ``keys`` owned by ``shard`` in an ``n``-wide fleet
+    — the pure placement predicate (no address list needed), used by the
+    server-side reshard-on-load owner filter."""
+    keys = np.asarray(keys, np.uint64)
+    if n <= 1:
+        return np.ones(keys.shape, bool)
+    return (_keyed_hash(keys, CLUSTER_SALT) % np.uint64(n)).astype(
+        np.int64) == int(shard)
+
+
+def make_server_map(addrs: Sequence[Tuple[str, int]],
+                    epoch: int = 0) -> ServerMap:
+    """Sanctioned ServerMap constructor for client/server code.
+
+    pboxlint PB803 flags direct ``ServerMap(...)`` construction outside
+    ps/cluster.py + ps/reshard.py so every membership change routes
+    through the reshard API; code that merely needs a map over a known
+    address list (PSClient ctor, server membership adoption) builds it
+    here.
+    """
+    return ServerMap(addrs, epoch=epoch)
+
+
+def map_from_desc(desc: Dict) -> ServerMap:
+    """Rebuild a ServerMap from a membership descriptor
+    (:meth:`ServerMap.describe` — health responses, redirect hints).
+    Accepts the wire string form and the in-process pair-list form."""
+    a = desc["addrs"]
+    addrs = parse_addrs(a) if isinstance(a, str) \
+        else [(h, int(p)) for h, p in a]
+    return ServerMap(addrs, epoch=int(desc.get("epoch", 0)))
 
 
 class _InflightBudget:
@@ -163,7 +227,8 @@ class _InflightBudget:
 
 
 def two_phase_lifecycle(client, verb: str, table: Optional[str] = None,
-                        timeout: float = 60.0):
+                        timeout: float = 60.0,
+                        extra: Optional[Dict] = None):
     """Run a decaying lifecycle verb cluster-wide, exactly once per shard.
 
     n == 1 degrades to the plain single-server dedup'd send (byte- and
@@ -172,13 +237,26 @@ def two_phase_lifecycle(client, verb: str, table: Optional[str] = None,
     pinned on the client until the commit completes, so a caller-level
     retry after any partial failure re-drives the SAME rids and the
     per-shard dedup windows collapse duplicates.
+
+    ``extra`` is merged into every phase frame — the reshard cutover uses
+    it to carry the new membership descriptor, so even a server that
+    crashed and lost its staged migration state can execute the commit
+    from the frame alone (the same self-containment the commit verb
+    already has).
     """
     if verb not in LIFECYCLE_VERBS:
         raise ValueError(f"not a cluster lifecycle verb: {verb!r}")
+    extra = extra or {}
     n = getattr(client, "n_shards", 1)
+    # every phase frame carries the client's membership epoch: a fleet
+    # that resharded since this client last refreshed answers a typed
+    # wrong_epoch instead of decaying only the shards the stale map
+    # names (the client's verb layer refreshes and re-drives — the
+    # pinned rid group makes the replay exactly-once per shard)
+    stamp = getattr(client, "_stamp_ep", None) or (lambda r: r)
     if n <= 1:
-        return client._call({"cmd": verb, "table": table}, dedup=True,
-                            timeout=timeout)
+        return client._call(stamp({"cmd": verb, "table": table, **extra}),
+                            dedup=True, timeout=timeout)
     t0 = time.perf_counter()
     txn_key = (verb, table or "")
     group = client._txn_groups.get(txn_key)
@@ -188,9 +266,9 @@ def two_phase_lifecycle(client, verb: str, table: Optional[str] = None,
     prepared: List[int] = []
     try:
         for shard in range(n):
-            client._call({"cmd": "lifecycle_prepare", "verb": verb,
-                          "table": table, "txn": group,
-                          wire.RID_FIELD: f"{group}.p{shard}"},
+            client._call(stamp({"cmd": "lifecycle_prepare", "verb": verb,
+                                "table": table, "txn": group, **extra,
+                                wire.RID_FIELD: f"{group}.p{shard}"}),
                          shard=shard, timeout=timeout)
             prepared.append(shard)
     except Exception:
@@ -199,9 +277,9 @@ def two_phase_lifecycle(client, verb: str, table: Optional[str] = None,
         # still commit — abort only clears server-side staging bookkeeping.
         for shard in prepared:
             try:
-                client._call({"cmd": "lifecycle_abort", "verb": verb,
-                              "table": table, "txn": group,
-                              wire.RID_FIELD: f"{group}.a{shard}"},
+                client._call(stamp({"cmd": "lifecycle_abort", "verb": verb,
+                                    "table": table, "txn": group, **extra,
+                                    wire.RID_FIELD: f"{group}.a{shard}"}),
                              shard=shard, timeout=5.0)
             except Exception:
                 pass
@@ -209,9 +287,9 @@ def two_phase_lifecycle(client, verb: str, table: Optional[str] = None,
         raise
     out = None
     for shard in range(n):
-        out = client._call({"cmd": "lifecycle_commit", "verb": verb,
-                            "table": table, "txn": group,
-                            wire.RID_FIELD: f"{group}.c{shard}"},
+        out = client._call(stamp({"cmd": "lifecycle_commit", "verb": verb,
+                                  "table": table, "txn": group, **extra,
+                                  wire.RID_FIELD: f"{group}.c{shard}"}),
                            shard=shard, timeout=timeout)
     client._txn_groups.pop(txn_key, None)
     stat_add("ps.cluster.lifecycle_commit")
@@ -261,9 +339,10 @@ def cluster_save(client, path: str, mode: str = "all",
     subdir regardless of how the delta keys hashed.
     """
     n = getattr(client, "n_shards", 1)
+    stamp = getattr(client, "_stamp_ep", None) or (lambda r: r)
     if n <= 1:
-        req: Dict = {"cmd": "save", "path": path, "mode": mode,
-                     "table": table}
+        req: Dict = stamp({"cmd": "save", "path": path, "mode": mode,
+                           "table": table})
         if keys is not None:
             req["keys"] = np.asarray(keys, np.uint64)
         return int(client._call(req, timeout=120)["saved"])
@@ -273,8 +352,8 @@ def cluster_save(client, path: str, mode: str = "all",
         pos = client.server_map.partition(keys)
 
     def build(shard: int) -> Dict:
-        req = {"cmd": "save", "path": shard_dir(path, shard), "mode": mode,
-               "table": table}
+        req = stamp({"cmd": "save", "path": shard_dir(path, shard),
+                     "mode": mode, "table": table})
         if pos is not None:
             req["keys"] = keys[pos[shard]]
         return req
@@ -283,16 +362,57 @@ def cluster_save(client, path: str, mode: str = "all",
     return sum(int(r["saved"]) for r in out)
 
 
+def dump_width(path: str) -> int:
+    """Number of contiguous ``shard-<k:03d>/`` subdirs under a cluster
+    dump path (0 = flat single-server dump)."""
+    k = 0
+    while os.path.isdir(shard_dir(path, k)):
+        k += 1
+    return k
+
+
 def cluster_load(client, path: str, mode: str = "all",
                  table: Optional[str] = None) -> int:
-    """Fan `load` out per shard from ``shard-<k:03d>/`` subdirs."""
+    """Fan `load` out per shard from ``shard-<k:03d>/`` subdirs.
+
+    **Reshard-on-load:** when the dump width on disk differs from the
+    fleet width (an N=4 dump restoring into an N=2 fleet, or a flat
+    single-server dump into any fleet), every fleet shard is asked to
+    walk ALL source subdirs itself with an ``owner`` filter — it keeps
+    only the keys that hash to it under the CURRENT map, so each row
+    lands on exactly one shard and the restored key space is identical
+    to a natively-sharded save.  The offline fallback to the live
+    handoff in ps/reshard.py.
+    """
     n = getattr(client, "n_shards", 1)
+    stamp = getattr(client, "_stamp_ep", None) or (lambda r: r)
+    src = dump_width(path)
     if n <= 1:
-        return int(client._call({"cmd": "load", "path": path, "mode": mode,
-                                 "table": table}, timeout=120)["loaded"])
+        if src in (0, 1):
+            p = path if src == 0 else shard_dir(path, 0)
+            return int(client._call(stamp({"cmd": "load", "path": p,
+                                           "mode": mode, "table": table}),
+                                    timeout=120)["loaded"])
+        r = client._call(stamp({"cmd": "load", "path": path, "mode": mode,
+                                "table": table,
+                                "owner": np.asarray([0, 1], np.int64),
+                                "src_shards": src}), timeout=120)
+        stat_add("ps.cluster.reshard_on_load")
+        return int(r["loaded"])
+    if src == n:
+        out = _fan_out(
+            client,
+            lambda shard: stamp({"cmd": "load",
+                                 "path": shard_dir(path, shard),
+                                 "mode": mode, "table": table}),
+            timeout=120)
+        return sum(int(r["loaded"]) for r in out)
     out = _fan_out(
         client,
-        lambda shard: {"cmd": "load", "path": shard_dir(path, shard),
-                       "mode": mode, "table": table},
+        lambda shard: stamp({"cmd": "load", "path": path, "mode": mode,
+                             "table": table,
+                             "owner": np.asarray([shard, n], np.int64),
+                             "src_shards": src}),
         timeout=120)
+    stat_add("ps.cluster.reshard_on_load")
     return sum(int(r["loaded"]) for r in out)

@@ -139,6 +139,10 @@ class DeviceRowCache:
         self._server_map = None
         self._device_rank = 0
         self._device_world = 1
+        # epoch stamp of the owned mask: the membership epoch the current
+        # admission placement was computed under.  A live reshard bumps it
+        # via update_server_map(), which also drops exactly the moved range.
+        self._map_epoch = 0
 
     def attach_server_map(self, server_map, device_rank: int = 0,
                           device_world: int = 1) -> None:
@@ -150,9 +154,64 @@ class DeviceRowCache:
         first admission in practice, so the set is empty).  Main thread
         only, between passes.
         """
-        self._server_map = server_map
-        self._device_rank = int(device_rank)
-        self._device_world = max(1, int(device_world))
+        with self._lock:
+            self._server_map = server_map
+            self._device_rank = int(device_rank)
+            self._device_world = max(1, int(device_world))
+            self._map_epoch = int(getattr(server_map, "epoch", 0))
+
+    @property
+    def map_epoch(self) -> int:
+        """Membership epoch the resident set's owned mask was stamped
+        under (0 when no ServerMap is attached)."""
+        return self._map_epoch
+
+    def update_server_map(self, new_map, reason: str = "") -> None:
+        """Adopt a post-reshard ServerMap, invalidating ONLY the moved
+        key range: rows whose owning shard is the same under the old and
+        new placement keep their device/host planes hot; rows whose
+        owner changed are dropped (their authoritative copy just moved
+        between PS processes).  The owned admission mask is re-stamped
+        with the new map's epoch.  Main thread only, between passes —
+        same discipline as :meth:`invalidate` (PB503).
+        """
+        with self._lock:
+            old_map = self._server_map
+            if old_map is None or (
+                    getattr(old_map, "n", 1) == getattr(new_map, "n", 1)
+                    and getattr(old_map, "addrs", None)
+                    == getattr(new_map, "addrs", None)):
+                # first attach, or a no-op refresh (same membership):
+                # nothing moved, just restamp
+                self._server_map = new_map
+                self._map_epoch = int(getattr(new_map, "epoch", 0))
+                return
+            keys = self._keys
+            slots = self._slots
+        if len(keys):
+            moved = old_map.shard_of_keys(keys) != new_map.shard_of_keys(keys)
+        else:
+            moved = np.zeros((0,), bool)
+        dropped = int(moved.sum())
+        drop_slots = slots[moved]
+        self._slot_key[drop_slots] = 0
+        self._slot_score[drop_slots] = 0.0
+        self._slot_pass[drop_slots] = -1
+        keep = ~moved
+        # version bump even when dropped == 0: in-flight snapshots may
+        # predate the epoch flip and must resolve all-miss for safety
+        with self._lock:
+            self.version += 1
+            self._keys = keys[keep]
+            self._slots = slots[keep]
+            self._server_map = new_map
+            self._map_epoch = int(getattr(new_map, "epoch", 0))
+            left = len(self._keys)
+        stat_set("ps.cache.resident_rows", float(left))
+        stat_add("ps.cache.invalidations")
+        flight.record("cache_invalidate_moved", epoch=self._map_epoch,
+                      reason=reason or "reshard", dropped=dropped,
+                      kept=left)
 
     # -- index (cross-thread surface) ---------------------------------------
     def snapshot(self) -> CacheIndexSnapshot:

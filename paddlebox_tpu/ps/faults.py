@@ -10,8 +10,10 @@ two ways, both driven by one seedable :class:`FaultPlan`:
     (server about to run a verb), and ``lifecycle`` (trainer-side
     SIGKILL-schedule points: ``ckpt_sparse`` mid-checkpoint-write,
     ``ckpt_commit`` between generation assembly and the MANIFEST pointer
-    swap, ``end_pass`` before the pass write-back — io/checkpoint.py and
-    ps/pass_manager.py fire them).  The hooks can drop the connection,
+    swap, ``end_pass`` before the pass write-back, and the live-reshard
+    windows ``reshard_snapshot`` / ``reshard_catchup`` /
+    ``reshard_cutover`` — io/checkpoint.py, ps/pass_manager.py and
+    ps/reshard.py fire them).  The hooks can drop the connection,
     delay it, truncate a frame mid-write, kill the server abruptly
     mid-verb, or simulate a process SIGKILL at a lifecycle point (the
     kill-anywhere chaos soak's seeded schedule).  Production pays zero
@@ -161,9 +163,13 @@ class FaultPlan:
                 prob: float = 0.0,
                 limit: Optional[int] = None) -> "FaultPlan":
         """Seeded SIGKILL schedule at a named lifecycle point
-        (``ckpt_sparse`` / ``ckpt_commit`` / ``end_pass``): the producer
-        site raises InjectedFault there, simulating an abrupt trainer
-        death whose kill points replay from this one plan/seed."""
+        (``ckpt_sparse`` / ``ckpt_commit`` / ``end_pass``, or the
+        migration windows ``reshard_snapshot`` — moving rows dumped but
+        no cutover staged, ``reshard_catchup`` — deltas shipped and the
+        moving range frozen, ``reshard_cutover`` — between the 2-phase
+        prepare and commit): the producer site raises InjectedFault
+        there, simulating an abrupt trainer/driver death whose kill
+        points replay from this one plan/seed."""
         return self.add_rule("lifecycle", FaultAction("kill"), None, at,
                              prob, limit=limit, cmd=point)
 
@@ -314,7 +320,9 @@ def on_lifecycle(point: str) -> None:
     """Trainer-side SIGKILL-schedule site: io/checkpoint.py fires it at
     ``ckpt_sparse`` (shard files down, generation not assembled) and
     ``ckpt_commit`` (generation assembled, MANIFEST not yet swapped);
-    ps/pass_manager.py fires ``end_pass`` before the pass write-back.
+    ps/pass_manager.py fires ``end_pass`` before the pass write-back;
+    ps/reshard.py fires ``reshard_snapshot`` / ``reshard_catchup`` /
+    ``reshard_cutover`` at the three migration crash windows.
     A matching ``kill`` rule raises InjectedFault — the abrupt-death
     simulation the auto-resume path (fleet.train_passes) must survive."""
     plan = ACTIVE

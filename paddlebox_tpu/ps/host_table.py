@@ -348,6 +348,39 @@ class ShardedHostTable:
 
         return sum(workpool.table_pool().map(shrink_shard, self._shards))
 
+    def filter_keys(self, keep_fn) -> int:
+        """Drop rows whose key fails ``keep_fn(keys) -> bool mask`` —
+        the reshard source-side moved-row drop (cutover commit) and the
+        reshard-on-load owner filter.  Returns rows removed."""
+        def filter_shard(shard) -> int:
+            with shard.lock:
+                keep = np.asarray(keep_fn(shard.keys), bool)
+                removed = int((~keep).sum())
+                if removed:
+                    shard.filter_keep(keep)
+                return removed
+
+        return sum(workpool.table_pool().map(filter_shard, self._shards))
+
+    def select_keys(self, mask_fn) -> np.ndarray:
+        """Resident keys for which ``mask_fn(keys) -> bool mask`` holds —
+        the reshard snapshot's moving-row enumeration (ps/service.py
+        ``reshard_begin``).  Shard-major order like export_keys; callers
+        needing determinism sort."""
+        def sel_shard(shard) -> np.ndarray:
+            with shard.lock:
+                keys = np.asarray(shard.keys, np.uint64)
+                if not len(keys):
+                    return keys
+                return keys[np.asarray(mask_fn(keys), bool)]
+
+        parts = [p for p in workpool.table_pool().map(sel_shard,
+                                                      self._shards)
+                 if len(p)]
+        if not parts:
+            return np.zeros((0,), np.uint64)
+        return np.concatenate(parts)
+
     # -- persistence (≙ SaveBase/SaveDelta box_wrapper.cc:1286; per-shard
     #    files with .shard suffix, memory_sparse_table.h:34) ----------------
     def save(self, path: str, mode: str = "base",

@@ -153,6 +153,16 @@ class BoxPSEngine:
                 self.cache.attach_server_map(
                     smap, device_rank=self.device_rank,
                     device_world=self.device_world)
+                # elastic fleet: when a fence redirect adopts a newer
+                # map, invalidate exactly the moved key range (stale
+                # cached rows now belong to a different shard)
+                client = getattr(self.table, "client", None)
+                if client is not None \
+                        and hasattr(client, "on_map_change"):
+                    cache = self.cache
+                    client.on_map_change(
+                        lambda m: cache.update_server_map(
+                            m, reason="map_refresh"))
                 # pboxlint: disable-next=PB102 -- single-coordinator lifecycle flag
                 self._cache_smap_attached = True
         # publish the cache index snapshot for THIS feed (prefetcher-safe:

@@ -50,6 +50,13 @@ def main(argv=None) -> int:
                     help="generation-checkpoint root to reload from")
     ap.add_argument("--shard", type=int, default=None,
                     help="cluster rank: reload only shard-<k:03d>/ subdirs")
+    ap.add_argument("--membership", default=None,
+                    help="fleet membership 'h1:p1,h2:p2,...' — enables "
+                         "epoch fencing; --shard -1 joins as a pending "
+                         "member (answers typed redirects until a "
+                         "reshard cutover admits it)")
+    ap.add_argument("--epoch", type=int, default=0,
+                    help="membership epoch the address list is valid at")
     args = ap.parse_args(argv)
 
     from paddlebox_tpu.config import EmbeddingTableConfig
@@ -71,8 +78,14 @@ def main(argv=None) -> int:
                 sparse = os.path.join(sparse, f"shard-{args.shard:03d}")
             dedup = _dedup_read(sparse)
 
+    membership = None
+    if args.membership:
+        from paddlebox_tpu.ps import cluster as ps_cluster
+        membership = ps_cluster.make_server_map(
+            ps_cluster.parse_addrs(args.membership), epoch=args.epoch)
     srv = PSServer(table, host=args.host, port=args.port,
-                   dedup_state=dedup)
+                   dedup_state=dedup, membership=membership,
+                   shard=args.shard if args.shard is not None else 0)
     print(f"PS_ADDR {srv.addr[0]}:{srv.addr[1]}", flush=True)
 
     done = threading.Event()

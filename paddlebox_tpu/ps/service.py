@@ -280,6 +280,85 @@ _RID_ECHO_ONLY = frozenset({"pull_sparse", "pull_dense", "size",
                             "list_tables", "health", "save", "load",
                             "forward", "dump_xbox"})
 
+# sparse data verbs that carry the client's membership epoch ("ep") and
+# are epoch/ownership-fenced on a membership-aware server (ps/reshard.py)
+_FENCED_VERBS = frozenset({"pull_sparse", "push_sparse",
+                           "push_sparse_delta", "forward"})
+
+# cluster control-plane verbs fenced on the epoch alone (they address
+# whole shards, so there is no per-key ownership to check): a client
+# fanning these out over a STALE map would fork the fleet — end_day
+# decays only the shards the old map names, save commits a
+# partial-width dump, load restores into a partition nobody routes by.
+# Exempt: the reshard driver's own traffic — lifecycle frames whose
+# verb is "reshard_cutover" (the cutover crosses the epoch by design
+# and its commit is epoch-guarded idempotent) and ingest loads marked
+# with RESHARD_FIELD (they target pending members that are not yet in
+# any map).
+_FENCED_CONTROL_VERBS = frozenset({"end_day", "save", "load", "shrink",
+                                   "lifecycle_prepare",
+                                   "lifecycle_commit",
+                                   "lifecycle_abort"})
+
+# epoch field riding fenced requests (kept short like wire.RID_FIELD)
+EPOCH_FIELD = "ep"
+
+# marks a frame as the reshard driver's own data path (ps/reshard.py
+# ingest) — skipped by the control-plane fence
+RESHARD_FIELD = "rsd"
+
+
+class FenceError(Exception):
+    """Server-side typed epoch/ownership rejection.
+
+    Raised from the fence check that runs AFTER the dedup-window echo
+    (an applied duplicate still replays its cached ack) and BEFORE any
+    table mutation — so a ``not_owner``/``wrong_epoch`` response PROVES
+    the request was not applied, and ``_dispatch_dedup`` dropping the rid
+    on the way out means a later re-drive under the new map re-executes
+    cleanly.  ``dispatch_one`` renders it as a typed response
+    (``{"ok": False, "<kind>": True, "epoch": E, "membership": desc}``)
+    the client resolves by refreshing its map and re-driving only the
+    affected chunks — never a user-visible error."""
+
+    def __init__(self, kind: str, membership) -> None:
+        super().__init__(kind)
+        self.kind = kind            # "wrong_epoch" | "not_owner" | "migrating"
+        self.membership = membership
+
+    def resp(self) -> Dict:
+        out = {"ok": False, self.kind: True,
+               "error": f"fence: {self.kind}"}
+        if self.membership is not None:
+            out["epoch"] = self.membership.epoch
+            out["membership"] = self.membership.describe()
+        return out
+
+
+class _FenceRedirect(RuntimeError):
+    """Client-side image of a typed fence response (or an aggregate of
+    them across a pipelined fan-out).  ``hint`` is the freshest membership
+    descriptor the servers offered; ``partial`` maps shard -> the
+    per-chunk response list of that shard's pipeline run (``None`` =
+    chunk never resolved, ``ok: False`` + typed field = provably not
+    applied) so a non-idempotent verb can re-drive exactly the unapplied
+    chunks."""
+
+    def __init__(self, kind: str, hint: Optional[Dict] = None,
+                 partial: Optional[Dict[int, List[Optional[Dict]]]] = None):
+        super().__init__(f"fence redirect: {kind}")
+        self.kind = kind
+        self.hint = hint
+        self.partial = partial
+
+
+def _fence_kind(resp: Dict) -> Optional[str]:
+    """The typed fence marker of a failed response, if any."""
+    for kind in ("wrong_epoch", "not_owner", "migrating"):
+        if resp.get(kind):
+            return kind
+    return None
+
 # dedup-window snapshot rides in the checkpointed sparse dir, next to the
 # shard files it must stay consistent with
 DEDUP_FILE = "DEDUP.bin"
@@ -341,11 +420,29 @@ class PSServer:
     def __init__(self, table: Union[ShardedHostTable,
                                     Dict[str, ShardedHostTable]],
                  host: str = "127.0.0.1", port: int = 0,
-                 dedup_state: Optional[List[Tuple[str, bytes]]] = None):
+                 dedup_state: Optional[List[Tuple[str, bytes]]] = None,
+                 membership: Optional[Dict] = None, shard: int = 0):
         if isinstance(table, dict):
             self.tables: Dict[str, ShardedHostTable] = dict(table)
         else:
             self.tables = {DEFAULT_TABLE: table}
+        # elastic membership identity: the fleet map this server believes
+        # in (None = legacy single-server, never fences) and its own index
+        # in it (-1 = not a member — a retiring source after cutover, or a
+        # joining destination before it).  Fenced sparse verbs are checked
+        # against these; ps/reshard.py changes them via reshard_cutover.
+        self.membership: Optional[ps_cluster.ServerMap] = None
+        if membership is not None:
+            self.membership = (membership
+                               if isinstance(membership, ps_cluster.ServerMap)
+                               else ps_cluster.map_from_desc(membership))
+        self.shard = int(shard)
+        # in-progress migration staging (reshard_begin .. cutover):
+        # {"map": new ServerMap, "self_new": index-in-new-map (-1 leaving),
+        #  "dirty": {table: set(moved keys written since snapshot)},
+        #  "frozen": bool} — guarded by _reshard_lock
+        self._reshard_lock = lockdep.lock("ps.service.PSServer._reshard_lock")
+        self._reshard: Optional[Dict] = None
         self.dense: Dict[str, np.ndarray] = {}
         self._dense_lock = lockdep.lock("ps.service.PSServer._dense_lock")
         # per-table: delta merges need read-modify-write atomicity only
@@ -434,6 +531,14 @@ class PSServer:
                             # client's retry resolves through the dedup
                             # window (or a clean re-execute)
                             return False
+                        except FenceError as e:
+                            # typed epoch/ownership rejection (raised
+                            # before any mutation; the rid was dropped):
+                            # the client refreshes its map off the carried
+                            # descriptor and re-drives the chunk
+                            resp = e.resp()
+                            if wire.RID_FIELD in req:
+                                resp[wire.RID_FIELD] = req[wire.RID_FIELD]
                         except Exception as e:  # noqa: BLE001
                             resp = {"ok": False, "error": repr(e)}
                             if wire.RID_FIELD in req:
@@ -519,6 +624,149 @@ class PSServer:
                            f"(have {sorted(self.tables)})")
         return t
 
+    # -- elastic membership fence -------------------------------------------
+    def _fence(self, req: Dict) -> None:
+        """Epoch + ownership check for a fenced sparse verb.  Runs AFTER
+        the dedup echo (an applied duplicate replays its cached ack first)
+        and BEFORE any mutation, so every rejection proves non-application
+        and the dropped rid lets a re-drive under the new map execute
+        cleanly.  Ordering: epoch first (a stale client must refresh
+        before ownership means anything), then ownership, then the
+        migration freeze (writes into a frozen moving range)."""
+        m = self.membership
+        ep = req.get(EPOCH_FIELD)
+        if ep is None:
+            # unfenced legacy frame: serve while no reshard ever happened,
+            # reject loudly (typed, with the map) once one has — silently
+            # applying to a range this server may no longer own would
+            # corrupt the moved rows
+            if m.epoch <= 0:
+                return
+            stat_add("ps.server.fence_wrong_epoch")
+            raise FenceError("wrong_epoch", m)
+        if int(ep) != m.epoch:
+            # EITHER direction: a stale client refreshes off the carried
+            # descriptor; a client AHEAD of this server backs off bounded
+            # (the cutover commit fan-out is still reaching us)
+            stat_add("ps.server.fence_wrong_epoch")
+            raise FenceError("wrong_epoch", m)
+        if self.shard < 0:
+            # epoch matched but this server left the fleet (owned_mask
+            # degenerates to all-True at n == 1, so check explicitly)
+            stat_add("ps.server.fence_not_owner")
+            raise FenceError("not_owner", m)
+        keys = req.get("keys")
+        if keys is not None and m.n > 1:
+            keys = np.asarray(keys, np.uint64)
+            if len(keys) and not ps_cluster.owned_mask(
+                    keys, self.shard, m.n).all():
+                stat_add("ps.server.fence_not_owner")
+                raise FenceError("not_owner", m)
+        rs = self._reshard
+        if rs is not None and rs["frozen"] \
+                and req["cmd"] in ("push_sparse", "push_sparse_delta"):
+            # cutover freeze: only WRITES touching the moving range block
+            # (pulls still serve — the frozen values are consistent);
+            # non-moving keys of this shard keep full write rate
+            if keys is None:
+                keys = np.asarray(req.get("keys", ()), np.uint64)
+            if len(keys) and bool(
+                    (rs["map"].shard_of_keys(keys)
+                     != rs["self_new"]).any()):
+                stat_add("ps.server.fence_migrating")
+                raise FenceError("migrating", m)
+
+    def _track_dirty(self, req: Dict) -> None:
+        """Record moved-range keys a write touched during the un-frozen
+        migration window — the delta catch-up set (reshard_delta ships
+        exactly these rows)."""
+        rs = self._reshard
+        if rs is None or rs["frozen"]:
+            return
+        keys = np.asarray(req["keys"], np.uint64)
+        if not len(keys):
+            return
+        moving = rs["map"].shard_of_keys(keys) != rs["self_new"]
+        if moving.any():
+            tname = req.get("table") or DEFAULT_TABLE
+            with self._reshard_lock:
+                if self._reshard is rs:
+                    rs["dirty"].setdefault(tname, set()).update(
+                        int(k) for k in keys[moving])
+
+    def _moving_keys(self, tname: str, rs: Dict) -> np.ndarray:
+        """Keys of ``tname`` resident on this server that the staged new
+        map assigns elsewhere — the migration snapshot's row set."""
+        t = self.tables[tname]
+        return t.select_keys(
+            lambda k: rs["map"].shard_of_keys(k) != rs["self_new"])
+
+    def _dump_by_dst(self, tname: str, mk: np.ndarray, rs: Dict,
+                     path: str) -> int:
+        """Dump ``mk`` rows of ``tname`` split per DESTINATION shard into
+        ``<path>/dst-<d:03d>/table-<tname>`` — each destination ingests
+        only its own slice, so no server ever holds (or later re-ships)
+        rows it will not own.  Missing keys are skipped by save(mode=
+        "rows"), making retries after evictions harmless."""
+        dst = rs["map"].shard_of_keys(mk)
+        t = self.tables[tname]       # server-local dump, not a fleet send
+        moved = 0
+        for d in np.unique(dst):
+            moved += t.save(
+                os.path.join(path, f"dst-{int(d):03d}",
+                             f"table-{tname}"),
+                "rows", keys=np.sort(mk[dst == d]))
+        return moved
+
+    def _drop_unowned(self) -> int:
+        """Drop every resident row this server does not own under its
+        CURRENT membership — the cleanup that makes abandoned-migration
+        ingest (rows upserted into a destination before an abort)
+        invisible to later snapshots and to the union fleet state."""
+        m = self.membership
+        if m is None:
+            return 0
+        removed = 0
+        for t in self.tables.values():
+            if self.shard < 0:
+                removed += t.filter_keys(
+                    lambda k: np.zeros(len(k), bool))
+            elif m.n > 1:
+                removed += t.filter_keys(
+                    lambda k: ps_cluster.owned_mask(k, self.shard, m.n))
+        return removed
+
+    def _adopt_membership(self, desc: Dict, assign: Optional[Dict]) -> bool:
+        """Cutover commit: flip to the new map (idempotent — a duplicate
+        or late commit with a non-advancing epoch is a no-op), drop the
+        rows this server no longer owns, and unfreeze.  ``assign`` maps
+        "host:port" -> new shard index; absent/-1 = leaving the fleet
+        (the server keeps answering typed redirects until stopped)."""
+        new_map = ps_cluster.map_from_desc(desc)
+        me = f"{self.addr[0]}:{self.addr[1]}"
+        new_idx = int((assign or {}).get(me, -1))
+        with self._reshard_lock:
+            cur = self.membership
+            if cur is not None and new_map.epoch <= cur.epoch:
+                return False
+            self.membership = new_map
+            self.shard = new_idx
+            self._reshard = None
+        removed = 0
+        for t in self.tables.values():
+            if new_idx >= 0:
+                removed += t.filter_keys(
+                    lambda k: ps_cluster.owned_mask(k, new_idx, new_map.n))
+            else:
+                # leaving: every row was shipped — drop them all so a
+                # late unfenced read cannot see stale values
+                removed += t.filter_keys(
+                    lambda k: np.zeros(len(k), bool))
+        stat_add("ps.server.reshard_rows_dropped", float(removed))
+        flight.record("reshard_cutover", epoch=new_map.epoch,
+                      shard=new_idx, dropped=removed)
+        return True
+
     def _dispatch(self, req: Dict) -> Dict:
         """Fault hook + exactly-once wrapper around the verb switch.
         Observes every verb's server-side dispatch latency (dedup replays
@@ -568,6 +816,13 @@ class PSServer:
         replay returns before reaching here — chaos retries never
         duplicate server spans) and parents to the originating client
         span via the wire trace context."""
+        if self.membership is not None:
+            cmd = req.get("cmd")
+            if cmd in _FENCED_VERBS \
+                    or (cmd in _FENCED_CONTROL_VERBS
+                        and req.get("verb") != "reshard_cutover"
+                        and not req.get(RESHARD_FIELD)):
+                self._fence(req)
         tr = trace.ACTIVE
         if tr is None:
             return self._exec_verb(req)
@@ -592,6 +847,10 @@ class PSServer:
                 with self._delta_locks[req.get("table") or DEFAULT_TABLE]:
                     rows = t.bulk_pull(req["keys"])   # pboxlint: disable=PB602 -- verb-serialization by design
                     t.bulk_write(req["keys"], rows)   # pboxlint: disable=PB602 -- verb-serialization by design
+                if self._reshard is not None:
+                    # fresh-row defaults persisted mid-migration are
+                    # moved-range state too — catch-up must ship them
+                    self._track_dirty(req)
             else:
                 rows = t.bulk_pull(req["keys"])
             wd = req.get("wire_dtype")
@@ -602,6 +861,8 @@ class PSServer:
             return {"ok": True, "rows": rows}
         if cmd == "push_sparse":
             self._table(req).bulk_write(req["keys"], req["rows"])
+            if self._reshard is not None:
+                self._track_dirty(req)
             return {"ok": True}
         if cmd == "push_sparse_delta":
             # geo/Hogwild-style merge for concurrent trainers: read-modify-
@@ -624,6 +885,8 @@ class PSServer:
                 if "unseen_days" in cur:
                     cur["unseen_days"] = np.zeros_like(cur["unseen_days"])
                 t.bulk_write(req["keys"], cur)   # pboxlint: disable=PB602 -- verb-serialization by design
+            if self._reshard is not None:
+                self._track_dirty(req)
             return {"ok": True}
         if cmd == "pull_dense":
             with self._dense_lock:
@@ -651,6 +914,31 @@ class PSServer:
             _dedup_dump(req["path"], self._dedup.export())
             return {"ok": True, "saved": n}
         if cmd == "load":
+            owner = req.get("owner")
+            if owner is not None:
+                # reshard-on-load (ps/cluster.cluster_load): the dump
+                # width differs from the fleet width — walk EVERY source
+                # subdir, then keep only the keys this shard owns under
+                # the current map.  Clear-first preserves replace
+                # semantics across the multi-dir upsert.  DEDUP.bin is
+                # deliberately NOT restored: rid windows describe a
+                # same-width server's history and don't map across
+                # widths (clients are fresh after an offline reshard).
+                t = self._table(req)
+                shard_idx, n_width = int(owner[0]), int(owner[1])
+                src = int(req.get("src_shards", 0))
+                if req.get("mode", "replace") == "replace":
+                    t.filter_keys(lambda k: np.zeros(len(k), bool))
+                dirs = ([req["path"]] if src == 0 else
+                        [ps_cluster.shard_dir(req["path"], k)
+                         for k in range(src)])
+                n = 0
+                for d in dirs:
+                    n += t.load(d, "upsert")
+                n -= t.filter_keys(
+                    lambda k: ps_cluster.owned_mask(k, shard_idx, n_width))
+                stat_add("ps.server.reshard_on_load")
+                return {"ok": True, "loaded": n}
             n = self._table(req).load(req["path"],
                                       req.get("mode", "replace"))
             state = _dedup_read(req["path"])
@@ -671,9 +959,18 @@ class PSServer:
             # NOTHING.  The rid entering the dedup window here is what
             # makes a caller retry after partial failure exactly-once.
             verb = req.get("verb")
-            if verb not in ("end_day",):
+            if verb not in ps_cluster.LIFECYCLE_VERBS:
                 raise ValueError(f"unknown lifecycle verb: {verb!r}")
-            self._table(req)  # raises on unknown table before staging
+            if verb == "reshard_cutover":
+                # validate the self-contained commit CAN execute: the
+                # frame must carry the new membership.  A mid-migration
+                # restart that lost _reshard staging still prepares —
+                # the commit executes from the frame alone.
+                if not req.get("membership"):
+                    raise ValueError("reshard_cutover prepare without a "
+                                     "membership descriptor")
+            else:
+                self._table(req)  # raises on unknown table before staging
             with self._staged_lock:
                 self._staged[req["txn"]] = {"verb": verb,
                                             "table": req.get("table")}
@@ -689,6 +986,14 @@ class PSServer:
                 self._staged.pop(req.get("txn") or "", None)
             if verb == "end_day":
                 self._table(req).end_day()
+            elif verb == "reshard_cutover":
+                # adopt the frame's membership (idempotent on a duplicate
+                # commit — the epoch guard makes it a no-op), drop moved
+                # rows, unfreeze.  Self-contained like end_day's commit.
+                if faults.ACTIVE is not None:
+                    faults.on_lifecycle("reshard_cutover")
+                self._adopt_membership(req["membership"],
+                                       req.get("assign"))
             else:
                 raise ValueError(f"unknown lifecycle verb: {verb!r}")
             stat_add("ps.server.lifecycle_commit")
@@ -696,8 +1001,84 @@ class PSServer:
         if cmd == "lifecycle_abort":
             with self._staged_lock:
                 self._staged.pop(req.get("txn") or "", None)
+            if req.get("verb") == "reshard_cutover":
+                # abandon the migration: discard staging + dirty set,
+                # unfreeze, and drop any rows ingested as a destination —
+                # the old membership keeps serving exactly its own key
+                # range (rollback is the MANIFEST's old epoch; owned
+                # table state never changed)
+                with self._reshard_lock:
+                    self._reshard = None
+                dropped = self._drop_unowned()
+                flight.record("reshard_abort", shard=self.shard,
+                              dropped=dropped)
             stat_add("ps.server.lifecycle_abort")
             return {"ok": True}
+        if cmd == "reshard_begin":
+            # migration phase 1 (ps/reshard.py): stage the proposed map,
+            # start tracking writes into the moving range, and dump the
+            # moving rows of EVERY table as the migration snapshot (the
+            # same tmp+rename'd per-shard dump files checkpoints use).
+            # Dedup'd + idempotent-by-re-snapshot: a retry (dropped rid,
+            # or a restarted driver with a fresh rid) re-stages and
+            # re-dumps CURRENT state, so nothing written between
+            # attempts can be lost.
+            new_map = ps_cluster.map_from_desc(req["membership"])
+            self_new = int(req.get("self_new", -1))
+            # self-clean first: an abandoned earlier migration may have
+            # left ingested rows this server doesn't own — shipping those
+            # stale copies would race the true owner's fresh dump
+            self._drop_unowned()
+            with self._reshard_lock:
+                self._reshard = {"map": new_map, "self_new": self_new,
+                                 "dirty": {}, "frozen": False}
+            rs = self._reshard
+            moved = 0
+            for name in sorted(self.tables):
+                mk = self._moving_keys(name, rs)
+                if not len(mk):
+                    continue
+                moved += self._dump_by_dst(name, mk, rs, req["path"])
+            if faults.ACTIVE is not None:
+                faults.on_lifecycle("reshard_snapshot")
+            stat_add("ps.server.reshard_snapshot_rows", float(moved))
+            flight.record("reshard_begin", shard=self.shard,
+                          epoch=new_map.epoch, rows=moved)
+            return {"ok": True, "moved": moved}
+        if cmd == "reshard_delta":
+            # migration phase 2: ship the dirty (moved-range rows written
+            # since the snapshot) set.  CUMULATIVE — the dirty set is not
+            # cleared until cutover, so a kill between the dump and the
+            # ack can never lose a row (the retry re-ships it; the
+            # destination's keyed upsert is idempotent).  ``freeze=True``
+            # is the final round: moving-range WRITES start answering
+            # ``migrating``, in-flight verbs drain, then the closing
+            # delta is collected — nothing can dirty the range after it.
+            rs = self._reshard
+            if rs is None:
+                raise RuntimeError("reshard_delta without reshard_begin")
+            if bool(req.get("freeze")):
+                with self._reshard_lock:
+                    rs["frozen"] = True
+                with self._inflight_cv:
+                    deadline = time.monotonic() + 5.0
+                    while self._inflight > 1:
+                        rem = deadline - time.monotonic()
+                        if rem <= 0:
+                            break
+                        self._inflight_cv.wait(rem)
+            with self._reshard_lock:
+                dirty = {name: np.sort(np.fromiter(ks, np.uint64,
+                                                   count=len(ks)))
+                         for name, ks in rs["dirty"].items() if ks}
+            moved = 0
+            for name, mk in sorted(dirty.items()):
+                moved += self._dump_by_dst(name, mk, rs, req["path"])
+            if faults.ACTIVE is not None:
+                faults.on_lifecycle("reshard_catchup")
+            stat_add("ps.server.reshard_delta_rows", float(moved))
+            return {"ok": True, "moved": moved,
+                    "frozen": bool(rs["frozen"])}
         if cmd == "dump_xbox":
             # server-side xbox dump of THIS shard's rows (cluster fan-out
             # writes per-shard part files the client concatenates); lazy
@@ -722,12 +1103,20 @@ class PSServer:
             # percentiles included) even with FLAGS_obs_port off
             with self._inflight_cv:
                 inflight = self._inflight
-            return {"ok": True, "mode": self.mode,
-                    "draining": self._draining,
-                    "inflight": inflight,
-                    "tables": ",".join(sorted(self.tables)),
-                    "stats": {k: float(v)
-                              for k, v in stat_snapshot("ps.").items()}}
+            out = {"ok": True, "mode": self.mode,
+                   "draining": self._draining,
+                   "inflight": inflight,
+                   "tables": ",".join(sorted(self.tables)),
+                   "stats": {k: float(v)
+                             for k, v in stat_snapshot("ps.").items()}}
+            if self.membership is not None:
+                # membership authority surface: clients refresh their
+                # ServerMap from ANY live member's health (shard 0
+                # preferred, falling through dead entries)
+                out["membership"] = self.membership.describe()
+                out["shard"] = self.shard
+                out["migrating"] = self._reshard is not None
+            return out
         if cmd == "barrier":
             world = req["world"]
             with self._barrier_cv:
@@ -881,12 +1270,16 @@ class _Stream:
     lives on exactly one server, so cross-shard failover of a chunk
     would be meaningless."""
 
-    __slots__ = ("idx", "shard", "sock")
+    __slots__ = ("idx", "shard", "sock", "gen")
 
-    def __init__(self, idx: int, shard: int = 0):
+    def __init__(self, idx: int, shard: int = 0, gen: int = 0):
         self.idx = idx
         self.shard = shard
         self.sock: Optional[socket.socket] = None
+        # pool generation: a membership refresh swaps the whole pool; a
+        # stream from a previous generation checking back in is closed
+        # and discarded instead of polluting the new pool
+        self.gen = gen
 
 
 class _PipelineRun:
@@ -1023,13 +1416,24 @@ class PSClient:
             addrs = [tuple(a) for a in addr]
         else:
             addrs = [tuple(addr)]
-        self.server_map = ps_cluster.ServerMap(addrs)
+        self.server_map = ps_cluster.make_server_map(addrs)
         self.n_shards = self.server_map.n
         self.addr = self.server_map.addrs[0]   # back-compat (shard 0)
+        # elastic-membership plumbing: callbacks fired after a map
+        # refresh adopts a newer epoch (the DeviceRowCache invalidates
+        # its moved range here), and the pool generation counter
+        self._map_listeners: List = []
+        self._pool_gen = 0
         # pinned 2-phase lifecycle rid-groups keyed by (verb, table):
         # a caller retry of a partially-failed cluster lifecycle replays
         # the SAME prepare/commit rids (ps/cluster.two_phase_lifecycle)
         self._txn_groups: Dict[Tuple[str, str], str] = {}
+        # delta-push rid groups in flight -> (epoch, addrs) at first
+        # send; a pinned-group replay that lands after a membership
+        # change resolves its chunk fates against THIS fleet (see
+        # _resolve_group) instead of re-chunking under the new one
+        self._group_fleets: "OrderedDict[str, Tuple[int, List]]" = \
+            OrderedDict()
         self.retries = retries
         self.retry_sleep = retry_sleep      # backoff base
         self.backoff_cap = backoff_cap
@@ -1057,7 +1461,7 @@ class PSClient:
         self._lock = lockdep.lock("ps.service.PSClient._lock")
         # connection pool: ``streams`` connections PER SHARD, checked out
         # exclusively via one _pool_cv; a stream is pinned to its shard
-        self._pool = [_Stream(i, shard=s)
+        self._pool = [_Stream(i, shard=s, gen=self._pool_gen)
                       for s in range(self.n_shards)
                       for i in range(self.streams)]
         self._free: List[List[_Stream]] = [
@@ -1117,16 +1521,25 @@ class PSClient:
     # -- stream pool ---------------------------------------------------------
     def _checkout(self, shard: int = 0) -> _Stream:
         with self._pool_cv:
-            while not self._free[shard]:
+            while True:
+                if shard >= len(self._free):
+                    # the map shrank under this verb's feet — surface as
+                    # a fence redirect: the verb re-partitions + re-drives
+                    raise _FenceRedirect("wrong_epoch")
+                if self._free[shard]:
+                    return self._free[shard].pop()
                 self._pool_cv.wait()
-            return self._free[shard].pop()
 
     def _checkout_upto(self, n: int, shard: int = 0) -> List[_Stream]:
         """Up to ``n`` free streams of one shard — at least one (blocks
         for the first); a concurrent verb holding part of the pool never
         deadlocks a pipelined call, it just narrows it."""
         with self._pool_cv:
-            while not self._free[shard]:
+            while True:
+                if shard >= len(self._free):
+                    raise _FenceRedirect("wrong_epoch")
+                if self._free[shard]:
+                    break
                 self._pool_cv.wait()
             take = min(n, len(self._free[shard]))
             out = [self._free[shard].pop() for _ in range(take)]
@@ -1135,6 +1548,10 @@ class PSClient:
     def _checkin(self, *streams: _Stream) -> None:
         with self._pool_cv:
             for st in streams:
+                if st.gen != self._pool_gen:
+                    # stream from a pre-refresh pool: retire it
+                    self._close_stream(st)
+                    continue
                 self._free[st.shard].append(st)
             self._pool_cv.notify_all()
 
@@ -1147,8 +1564,13 @@ class PSClient:
             faults.on_connect("client")
         rem = bo.remaining()
         cto = timeout if rem is None else max(min(timeout, rem), 0.001)
+        addrs = self.server_map.addrs
+        if stream.shard >= len(addrs):
+            # a concurrent map refresh shrank the fleet — the normal
+            # requeue/retry path resolves the chunks on the new map
+            raise ConnectionError("stale stream shard after map refresh")
         stream.sock = socket.create_connection(
-            self.server_map.addrs[stream.shard], timeout=cto)
+            addrs[stream.shard], timeout=cto)
 
     @staticmethod
     def _close_stream(stream: _Stream) -> None:
@@ -1165,6 +1587,112 @@ class PSClient:
         with self._pool_cv:
             for s in self._pool:
                 self._close_stream(s)
+
+    # -- elastic membership (epoch-fenced routing) --------------------------
+    def on_map_change(self, cb) -> None:
+        """Register ``cb(new_map)`` to fire after a refresh adopts a
+        newer membership epoch — the DeviceRowCache drops exactly its
+        moved range here (device_cache.update_server_map)."""
+        self._map_listeners.append(cb)
+
+    def _adopt_map(self, new_map: ps_cluster.ServerMap) -> bool:
+        """Swap to a newer membership map: rebuild the stream pool (old
+        streams retire as they check back in — the generation stamp keeps
+        them out of the new pool) and notify listeners.  No-op unless the
+        epoch actually advances."""
+        with self._pool_cv:
+            cur = self.server_map
+            if new_map.epoch <= cur.epoch:
+                return False
+            self.server_map = new_map
+            self.n_shards = new_map.n
+            self.addr = new_map.addrs[0]
+            self._pool_gen += 1
+            # only FREE streams retire here; checked-out ones close
+            # themselves on check-in via the generation stamp
+            old_free = [st for lst in self._free for st in lst]
+            self._pool = [_Stream(i, shard=s, gen=self._pool_gen)
+                          for s in range(self.n_shards)
+                          for i in range(self.streams)]
+            self._free = [[st for st in self._pool if st.shard == s]
+                          for s in range(self.n_shards)]
+            for st in old_free:
+                self._close_stream(st)
+            self._pool_cv.notify_all()
+        stat_add("ps.client.map_refresh")
+        flight.record("map_refresh", epoch=new_map.epoch, n=new_map.n)
+        for cb in list(self._map_listeners):
+            cb(new_map)
+        return True
+
+    def _probe_membership(self, addr: Tuple[str, int],
+                          timeout: float) -> Optional[Dict]:
+        """One-shot health probe of a single address for its membership
+        descriptor — a raw connection, never the (possibly mid-swap)
+        pool."""
+        with socket.create_connection(tuple(addr),
+                                      timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            _send(sock, {"cmd": "health"}, role="client")
+            resp = _recv(sock, role="client")
+        if resp.get("ok"):
+            return resp.get("membership")
+        return None
+
+    def refresh_server_map(self, hint: Optional[Dict] = None,
+                           timeout: float = 5.0) -> bool:
+        """Re-learn the fleet membership and adopt the highest epoch
+        seen.  Candidates: the redirect ``hint`` a fenced server carried
+        (trusted directly — it is the authoritative map of a member),
+        then the health surface of every address we know — current map
+        first, hint addresses after — FALLING THROUGH dead entries
+        instead of pinning to shard 0, so a dead authority can never
+        orphan the fleet.  Returns True when a newer map was adopted."""
+        best: Optional[ps_cluster.ServerMap] = None
+        if hint:
+            best = ps_cluster.map_from_desc(hint)
+        seen = set()
+        cands: List[Tuple[str, int]] = []
+        for a in list(self.server_map.addrs) + (
+                list(best.addrs) if best is not None else []):
+            a = (a[0], int(a[1]))
+            if a not in seen:
+                seen.add(a)
+                cands.append(a)
+        for addr in cands:
+            try:
+                desc = self._probe_membership(addr, timeout)
+            except (ConnectionError, OSError):
+                stat_add("ps.client.map_probe_miss")
+                continue
+            if desc:
+                m = ps_cluster.map_from_desc(desc)
+                if best is None or m.epoch > best.epoch:
+                    best = m
+                break   # first LIVE answer wins (plus any newer hint)
+        if best is None:
+            return False
+        return self._adopt_map(best)
+
+    def _fence_recover(self, e: "_FenceRedirect", bo: Backoff,
+                       attempt: int) -> None:
+        """Shared verb-level recovery from a typed fence rejection:
+        refresh the map off the hint; when nothing newer exists (the
+        server is mid-commit behind us, or the range is frozen for the
+        cutover) back off bounded — a stall here is the migration's
+        blocking window, never an error."""
+        stat_add("ps.client.fence_redirect")
+        flight.record("fence_redirect", fence=e.kind,
+                      epoch=self.server_map.epoch, attempt=attempt)
+        changed = False
+        try:
+            changed = self.refresh_server_map(hint=e.hint)
+        except (ConnectionError, OSError):
+            pass
+        if not changed and not bo.sleep(attempt):
+            raise ConnectionError(
+                f"fence redirect unresolved after {attempt} attempt(s): "
+                f"{e.kind} at epoch {self.server_map.epoch}") from e
 
     def _call(self, req: Dict, retry: bool = True,
               timeout: float = 60, deadline: Optional[float] = None,
@@ -1248,6 +1776,14 @@ class PSClient:
                 raise
             self._checkin(stream)
             if not resp.get("ok"):
+                kind = _fence_kind(resp)
+                if kind is not None:
+                    # typed epoch/ownership rejection: provably NOT
+                    # applied (the fence precedes any mutation and the
+                    # rid was dropped) — the verb layer refreshes the
+                    # map and re-drives
+                    raise _FenceRedirect(kind,
+                                         hint=resp.get("membership"))
                 raise RuntimeError(resp.get("error", "ps error"))
             cmd = req.get("cmd")
             stat_observe(f"ps.client.{cmd}.latency_s",
@@ -1265,8 +1801,22 @@ class PSClient:
         if not reqs:
             return []
         if len(reqs) == 1 or self.streams == 1:
-            return [self._call(r, timeout=timeout, shard=shard)
-                    for r in reqs]
+            out: List[Dict] = []
+            for r in reqs:
+                try:
+                    out.append(self._call(r, timeout=timeout,
+                                          shard=shard))
+                except _FenceRedirect as e:
+                    # fenced chunk = provably unapplied; later chunks
+                    # were never sent — mark both typed so the verb
+                    # layer re-drives them without probing
+                    partial: List[Optional[Dict]] = list(out)
+                    partial += [{"ok": False, e.kind: True}
+                                for _ in range(len(reqs) - len(out))]
+                    raise _FenceRedirect(e.kind, hint=e.hint,
+                                         partial={shard: partial}) \
+                        from None
+            return out
         streams = self._checkout_upto(min(self.streams, len(reqs)), shard)
         run = _PipelineRun(reqs, self.window, retries=self.retries)
         depth = max(1, -(-self.window // len(streams)))  # ceil division
@@ -1283,6 +1833,10 @@ class PSClient:
                 t.join()
             self._checkin(*streams)
         if run.error is not None:
+            if isinstance(run.error, _FenceRedirect):
+                raise _FenceRedirect(run.error.kind,
+                                     hint=run.error.hint,
+                                     partial={shard: list(run.results)})
             raise run.error
         if not run.finished():
             raise ConnectionError(
@@ -1344,6 +1898,22 @@ class PSClient:
             for t in pumps:
                 t.join()
             self._checkin(*held)
+        fence: Optional[_FenceRedirect] = None
+        for s, run in runs.items():
+            if isinstance(run.error, _FenceRedirect):
+                e = run.error
+                if fence is None or (
+                        (e.hint or {}).get("epoch", -1)
+                        > (fence.hint or {}).get("epoch", -1)):
+                    fence = e
+        if fence is not None:
+            # aggregate: carry EVERY shard's per-chunk results so the
+            # verb layer can re-drive exactly the unapplied chunks of
+            # the whole fan-out (unfenced shards' unfinished chunks ride
+            # along as unresolved)
+            raise _FenceRedirect(fence.kind, hint=fence.hint,
+                                 partial={s: list(runs[s].results)
+                                          for s in runs})
         for s, run in runs.items():
             if run.error is not None:
                 raise run.error
@@ -1426,8 +1996,16 @@ class PSClient:
                             cv.notify_all()
                         if not resp.get("ok"):
                             run.complete(idx, resp)
-                            run.abort(RuntimeError(
-                                resp.get("error", "ps error")))
+                            kind = _fence_kind(resp)
+                            if kind is not None:
+                                # typed fence: stop the run; the verb
+                                # layer inspects per-chunk results and
+                                # re-drives only the unapplied ones
+                                run.abort(_FenceRedirect(
+                                    kind, hint=resp.get("membership")))
+                            else:
+                                run.abort(RuntimeError(
+                                    resp.get("error", "ps error")))
                         else:
                             run.complete(idx, resp)
                 except (ConnectionError, OSError) as e:
@@ -1533,13 +2111,23 @@ class PSClient:
             req[wire.TRACE_FIELD] = ctx
         return req
 
+    def _stamp_ep(self, req: Dict) -> Dict:
+        """Ride the membership epoch on a fenced sparse verb.  Skipped
+        for a plain epoch-0 single server (frames stay byte-compatible
+        with the pre-elastic wire); once the fleet is sharded or any
+        reshard has happened, every fenced frame carries it."""
+        smap = self.server_map
+        if smap.n > 1 or smap.epoch > 0:
+            req[EPOCH_FIELD] = smap.epoch
+        return req
+
     def _pull_req(self, sub_keys: np.ndarray, table: Optional[str],
                   create: bool) -> Dict:
         req = {"cmd": "pull_sparse", "keys": sub_keys, "table": table,
                "create": create, wire.RID_FIELD: self._next_rid()}
         if self.wire_dtype != "f32":
             req["wire_dtype"] = self.wire_dtype
-        return self._stamp_trace(req)
+        return self._stamp_trace(self._stamp_ep(req))
 
     def pull_sparse(self, keys: np.ndarray, table: Optional[str] = None,
                     create: bool = False) -> Dict[str, np.ndarray]:
@@ -1551,9 +2139,21 @@ class PSClient:
         deterministic chunking for a given first response."""
         keys = np.asarray(keys)
         with trace.span("ps.client.pull_sparse.bulk", keys=len(keys)):
-            if self.n_shards > 1 and len(keys):
-                return self._pull_sparse_sharded(keys, table, create)
-            return self._pull_sparse_chunked(keys, table, create)
+            bo = Backoff(base=self.retry_sleep, cap=self.backoff_cap,
+                         deadline=self.deadline)
+            attempt = 0
+            while True:
+                try:
+                    if self.n_shards > 1 and len(keys):
+                        return self._pull_sparse_sharded(keys, table,
+                                                         create)
+                    return self._pull_sparse_chunked(keys, table, create)
+                except _FenceRedirect as e:
+                    # pulls are idempotent — refresh the map and re-pull
+                    # whole (re-partitioned under the new epoch); never
+                    # a user-visible error
+                    attempt += 1
+                    self._fence_recover(e, bo, attempt)
 
     def _pull_sparse_chunked(self, keys: np.ndarray, table: Optional[str],
                              create: bool) -> Dict[str, np.ndarray]:
@@ -1647,44 +2247,60 @@ class PSClient:
                     table: Optional[str] = None):
         keys = np.asarray(keys)
         with trace.span("ps.client.push_sparse.bulk", keys=len(keys)):
-            if self.n_shards > 1 and len(keys):
-                per_row = self._rows_bytes(rows)
-                reqs_by_shard: Dict[int, List[Dict]] = {}
-                for shard, p in enumerate(
-                        self.server_map.partition(keys)):
-                    if not len(p):
-                        continue
-                    stat_add(f"ps.cluster.s{shard}.push_keys",
-                             float(len(p)))
-                    stat_add(f"ps.cluster.s{shard}.est_bytes",
-                             float(len(p) * per_row))
-                    sub_rows = {f: np.asarray(v)[p]
-                                for f, v in rows.items()}
-                    reqs = []
-                    for lo, c in self._chunk_counts(len(p), per_row):
-                        chunk = {f: v[lo:lo + c]
-                                 for f, v in sub_rows.items()}
-                        reqs.append(self._stamp_trace(
-                            {"cmd": "push_sparse",
-                             "keys": keys[p[lo:lo + c]],
-                             "rows": self._quant_rows(chunk,
-                                                      "push_sparse"),
-                             "table": table,
-                             wire.RID_FIELD: self._next_rid()}))
-                    reqs_by_shard[shard] = reqs
-                self._pipeline_sharded(reqs_by_shard)
-                return
+            bo = Backoff(base=self.retry_sleep, cap=self.backoff_cap,
+                         deadline=self.deadline)
+            attempt = 0
+            while True:
+                try:
+                    return self._push_sparse_once(keys, rows, table)
+                except _FenceRedirect as e:
+                    # absolute-row pushes are idempotent (re-applying
+                    # the same values is a no-op), so whole-verb re-drive
+                    # under the refreshed map is exact
+                    attempt += 1
+                    self._fence_recover(e, bo, attempt)
+
+    def _push_sparse_once(self, keys: np.ndarray,
+                          rows: Dict[str, np.ndarray],
+                          table: Optional[str]):
+        if self.n_shards > 1 and len(keys):
             per_row = self._rows_bytes(rows)
-            reqs = []
-            for lo, c in self._chunk_counts(len(keys), per_row):
-                chunk = {f: np.asarray(v)[lo:lo + c]
-                         for f, v in rows.items()}
-                reqs.append(self._stamp_trace(
-                    {"cmd": "push_sparse", "keys": keys[lo:lo + c],
-                     "rows": self._quant_rows(chunk, "push_sparse"),
-                     "table": table,
-                     wire.RID_FIELD: self._next_rid()}))
-            self._pipeline(reqs)
+            reqs_by_shard: Dict[int, List[Dict]] = {}
+            for shard, p in enumerate(
+                    self.server_map.partition(keys)):
+                if not len(p):
+                    continue
+                stat_add(f"ps.cluster.s{shard}.push_keys",
+                         float(len(p)))
+                stat_add(f"ps.cluster.s{shard}.est_bytes",
+                         float(len(p) * per_row))
+                sub_rows = {f: np.asarray(v)[p]
+                            for f, v in rows.items()}
+                reqs = []
+                for lo, c in self._chunk_counts(len(p), per_row):
+                    chunk = {f: v[lo:lo + c]
+                             for f, v in sub_rows.items()}
+                    reqs.append(self._stamp_trace(self._stamp_ep(
+                        {"cmd": "push_sparse",
+                         "keys": keys[p[lo:lo + c]],
+                         "rows": self._quant_rows(chunk,
+                                                  "push_sparse"),
+                         "table": table,
+                         wire.RID_FIELD: self._next_rid()})))
+                reqs_by_shard[shard] = reqs
+            self._pipeline_sharded(reqs_by_shard)
+            return
+        per_row = self._rows_bytes(rows)
+        reqs = []
+        for lo, c in self._chunk_counts(len(keys), per_row):
+            chunk = {f: np.asarray(v)[lo:lo + c]
+                     for f, v in rows.items()}
+            reqs.append(self._stamp_trace(self._stamp_ep(
+                {"cmd": "push_sparse", "keys": keys[lo:lo + c],
+                 "rows": self._quant_rows(chunk, "push_sparse"),
+                 "table": table,
+                 wire.RID_FIELD: self._next_rid()})))
+        self._pipeline(reqs)
 
     def push_sparse_delta(self, keys: np.ndarray,
                           rows: Dict[str, np.ndarray],
@@ -1704,48 +2320,86 @@ class PSClient:
         group = rid_group or self.new_rid_group()
         with trace.span("ps.client.push_sparse_delta.bulk",
                         keys=len(keys), group=group):
-            per_row = self._rows_bytes(rows) + self._rows_bytes(rows_abs)
-            if self.n_shards > 1 and len(keys):
-                # sharded delta rids are ``<group>.<shard>.<i>``: the
-                # partition is a pure function of the keys, so a pinned-
-                # group caller replay reproduces byte-identical per-shard
-                # chunks under identical rids — exactly-once per shard
-                reqs_by_shard: Dict[int, List[Dict]] = {}
-                for shard, p in enumerate(
-                        self.server_map.partition(keys)):
-                    if not len(p):
-                        continue
-                    stat_add(f"ps.cluster.s{shard}.push_keys",
-                             float(len(p)))
-                    stat_add(f"ps.cluster.s{shard}.est_bytes",
-                             float(len(p) * per_row))
-                    sub_rows = {f: np.asarray(v)[p]
-                                for f, v in rows.items()}
-                    sub_abs = {f: np.asarray(v)[p]
-                               for f, v in rows_abs.items()}
-                    shard_reqs = []
-                    for i, (lo, c) in enumerate(
-                            self._chunk_counts(len(p), per_row)):
-                        delta = {f: v[lo:lo + c]
-                                 for f, v in sub_rows.items()}
-                        shard_reqs.append(self._stamp_trace(
-                            {"cmd": "push_sparse_delta",
-                             "keys": keys[p[lo:lo + c]],
-                             "rows": self._quant_rows(
-                                 delta, "push_sparse_delta"),
-                             "rows_abs": {f: v[lo:lo + c]
-                                          for f, v in sub_abs.items()},
-                             "table": table,
-                             wire.RID_FIELD: f"{group}.{shard}.{i}"}))
-                    reqs_by_shard[shard] = shard_reqs
-                self._pipeline_sharded(reqs_by_shard)
-                return
-            reqs = []
+            bo = Backoff(base=self.retry_sleep, cap=self.backoff_cap,
+                         deadline=self.deadline)
+            attempt = 0
+            with self._lock:
+                rec = self._group_fleets.get(group)
+            if rec is not None and rec[0] != self.server_map.epoch:
+                # pinned-group replay ACROSS a membership change: the
+                # new partition would re-chunk under different rids, so
+                # first resolve every ORIGINAL chunk's fate (same rids
+                # against the recorded fleet — cached ack = applied,
+                # typed fence = provably not), then re-drive only the
+                # unapplied rows under the current map
+                pos = self._resolve_group(keys, rows, rows_abs, table,
+                                          group, rec)
+                with self._lock:
+                    self._group_fleets.pop(group, None)
+                if not len(pos):
+                    return
+                keys, rows, rows_abs = self._slice_rows(
+                    keys, rows, rows_abs, pos)
+                group = self.new_rid_group()
+            while True:
+                smap = self.server_map
+                with self._lock:
+                    if group not in self._group_fleets:
+                        self._group_fleets[group] = (smap.epoch,
+                                                     list(smap.addrs))
+                        while len(self._group_fleets) > 64:
+                            self._group_fleets.popitem(last=False)
+                reqs_by_shard, spans_by_shard = self._delta_reqs(
+                    keys, rows, rows_abs, table, group, smap)
+                try:
+                    if smap.n == 1:
+                        self._pipeline(reqs_by_shard[0])
+                    else:
+                        self._pipeline_sharded(reqs_by_shard)
+                    with self._lock:
+                        self._group_fleets.pop(group, None)
+                    return
+                except _FenceRedirect as e:
+                    # non-idempotent verb: disambiguate every chunk
+                    # before anything is re-sent under new rids
+                    attempt += 1
+                    pos = self._unapplied_positions(
+                        reqs_by_shard, spans_by_shard, e, smap.addrs)
+                    self._fence_recover(e, bo, attempt)
+                    with self._lock:
+                        self._group_fleets.pop(group, None)
+                    if not len(pos):
+                        return
+                    keys, rows, rows_abs = self._slice_rows(
+                        keys, rows, rows_abs, pos)
+                    group = self.new_rid_group()
+
+    @staticmethod
+    def _slice_rows(keys, rows, rows_abs, pos):
+        return (keys[pos],
+                {f: np.asarray(v)[pos] for f, v in rows.items()},
+                {f: np.asarray(v)[pos] for f, v in rows_abs.items()})
+
+    def _delta_reqs(self, keys, rows, rows_abs, table, group,
+                    smap: ps_cluster.ServerMap):
+        """Partition + chunk one logical delta push under ``smap`` —
+        a pure function of (keys, row widths, group, smap.n), so a
+        pinned-group replay rebuilds byte-identical frames under
+        identical rids.  n == 1 keeps the flat ``<group>.<i>`` rid form;
+        sharded rids are ``<group>.<shard>.<i>``.  Returns
+        (reqs_by_shard, spans_by_shard) with spans = each chunk's key
+        positions in the caller's array."""
+        per_row = self._rows_bytes(rows) + self._rows_bytes(rows_abs)
+        reqs_by_shard: Dict[int, List[Dict]] = {}
+        spans_by_shard: Dict[int, List[np.ndarray]] = {}
+        if smap.n == 1:
+            reqs: List[Dict] = []
+            spans: List[np.ndarray] = []
             for i, (lo, c) in enumerate(
                     self._chunk_counts(len(keys), per_row)):
                 delta = {f: np.asarray(v)[lo:lo + c]
                          for f, v in rows.items()}
-                reqs.append(self._stamp_trace(
+                reqs.append(self._stamp_trace(self._stamp_ep(
                     {"cmd": "push_sparse_delta",
                      "keys": keys[lo:lo + c],
                      "rows": self._quant_rows(delta,
@@ -1755,8 +2409,127 @@ class PSClient:
                      "rows_abs": {f: np.asarray(v)[lo:lo + c]
                                   for f, v in rows_abs.items()},
                      "table": table,
-                     wire.RID_FIELD: f"{group}.{i}"}))
-            self._pipeline(reqs)
+                     wire.RID_FIELD: f"{group}.{i}"})))
+                spans.append(np.arange(lo, lo + c))
+            reqs_by_shard[0] = reqs
+            spans_by_shard[0] = spans
+            return reqs_by_shard, spans_by_shard
+        for shard, p in enumerate(smap.partition(keys)):
+            if not len(p):
+                continue
+            stat_add(f"ps.cluster.s{shard}.push_keys", float(len(p)))
+            stat_add(f"ps.cluster.s{shard}.est_bytes",
+                     float(len(p) * per_row))
+            sub_rows = {f: np.asarray(v)[p] for f, v in rows.items()}
+            sub_abs = {f: np.asarray(v)[p] for f, v in rows_abs.items()}
+            shard_reqs = []
+            spans = []
+            for i, (lo, c) in enumerate(
+                    self._chunk_counts(len(p), per_row)):
+                delta = {f: v[lo:lo + c] for f, v in sub_rows.items()}
+                shard_reqs.append(self._stamp_trace(self._stamp_ep(
+                    {"cmd": "push_sparse_delta",
+                     "keys": keys[p[lo:lo + c]],
+                     "rows": self._quant_rows(delta,
+                                              "push_sparse_delta"),
+                     "rows_abs": {f: v[lo:lo + c]
+                                  for f, v in sub_abs.items()},
+                     "table": table,
+                     wire.RID_FIELD: f"{group}.{shard}.{i}"})))
+                spans.append(p[lo:lo + c])
+            reqs_by_shard[shard] = shard_reqs
+            spans_by_shard[shard] = spans
+        return reqs_by_shard, spans_by_shard
+
+    def _probe_chunk(self, addr: Tuple[str, int], req: Dict,
+                     timeout: float = 30.0) -> bool:
+        """Resolve one chunk's fate by re-sending it — SAME rid — to the
+        server that originally received it (a raw one-shot connection:
+        the pool may already index the new map).  A cached dedup ack (or
+        a fresh execution on a server that still owns the range) proves
+        applied-exactly-once; a typed fence proves never-applied.
+        Raises when the server stays unreachable past the retry budget —
+        the ambiguity then falls to caller-level pinned-group replay."""
+        bo = Backoff(base=self.retry_sleep, cap=self.backoff_cap,
+                     deadline=self.deadline)
+        attempt = 0
+        rid = req.get(wire.RID_FIELD)
+        while True:
+            try:
+                with socket.create_connection(tuple(addr),
+                                              timeout=timeout) as sock:
+                    sock.settimeout(timeout)
+                    _send(sock, req, role="client")
+                    resp = _recv(sock, role="client")
+                if rid is not None \
+                        and resp.get(wire.RID_FIELD, rid) != rid:
+                    raise ConnectionError("stale response (rid mismatch)")
+            except (ConnectionError, OSError) as err:
+                attempt += 1
+                stat_add("ps.client.retry")
+                exhausted = (self.retries is not None
+                             and attempt >= self.retries)
+                if exhausted or not bo.sleep(attempt):
+                    raise ConnectionError(
+                        f"chunk-fate probe to {addr} failed after "
+                        f"{attempt} attempt(s): {err}") from err
+                continue
+            stat_add("ps.client.fence_probe")
+            if resp.get("ok"):
+                return True
+            if _fence_kind(resp) is not None:
+                return False
+            raise RuntimeError(resp.get("error", "ps error"))
+
+    def _unapplied_positions(self, reqs_by_shard, spans_by_shard,
+                             e: "_FenceRedirect",
+                             addrs: List[Tuple[str, int]]) -> np.ndarray:
+        """Positions (into the verb's key array) of every chunk proven
+        NOT applied.  ok chunks are done; typed-fence chunks were
+        rejected before any mutation; unresolved chunks are probed
+        same-rid against their original server."""
+        unapplied: List[np.ndarray] = []
+        partial = e.partial or {}
+        for shard, reqs in reqs_by_shard.items():
+            resps = partial.get(shard)
+            for i, (req, span) in enumerate(
+                    zip(reqs, spans_by_shard[shard])):
+                resp = None if resps is None or i >= len(resps) \
+                    else resps[i]
+                if resp is not None and resp.get("ok"):
+                    continue
+                if resp is not None and _fence_kind(resp) is not None:
+                    unapplied.append(span)
+                    continue
+                if shard < len(addrs) \
+                        and self._probe_chunk(addrs[shard], req):
+                    continue
+                unapplied.append(span)
+        if not unapplied:
+            return np.zeros((0,), np.int64)
+        return np.sort(np.concatenate(unapplied))
+
+    def _resolve_group(self, keys, rows, rows_abs, table, group,
+                       rec) -> np.ndarray:
+        """A pinned-group replay arrived AFTER the map changed: rebuild
+        the group's original frames (chunking and partition are pure
+        functions, so the bytes and rids match what the failed attempt
+        sent) and probe every chunk against the recorded fleet.  Returns
+        the positions still unapplied — the caller re-drives exactly
+        those under the current map with a fresh group."""
+        epoch, addrs = rec
+        old_map = ps_cluster.make_server_map(addrs, epoch=epoch)
+        reqs_by_shard, spans_by_shard = self._delta_reqs(
+            keys, rows, rows_abs, table, group, old_map)
+        unapplied: List[np.ndarray] = []
+        for shard, reqs in reqs_by_shard.items():
+            for req, span in zip(reqs, spans_by_shard[shard]):
+                if not self._probe_chunk(addrs[shard], req):
+                    unapplied.append(span)
+        stat_add("ps.client.group_replay_resolve")
+        if not unapplied:
+            return np.zeros((0,), np.int64)
+        return np.sort(np.concatenate(unapplied))
 
     def pull_dense(self, name: str) -> Optional[np.ndarray]:
         return self._call({"cmd": "pull_dense", "name": name})["value"]
@@ -1765,33 +2538,61 @@ class PSClient:
         self._call({"cmd": "push_dense", "name": name,
                     "value": np.asarray(value), "add": add}, dedup=True)
 
+    def _control_fenced(self, fn):
+        """Run a cluster control-plane verb (end_day/save/load/shrink)
+        under the fence-recover loop: on a typed epoch rejection the
+        call PROVABLY did not reach that shard's mutation (and the
+        2-phase helper's pinned rids make any partially-applied shards
+        replay cached acks), so refresh-the-map-and-re-drive is exact.
+        Without this, a client holding a pre-reshard map would fan a
+        lifecycle verb over only the shards the OLD map names — end_day
+        decaying half a fleet is a silent table fork."""
+        bo = Backoff(base=self.retry_sleep, cap=self.backoff_cap,
+                     deadline=self.deadline)
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except _FenceRedirect as e:
+                attempt += 1
+                self._fence_recover(e, bo, attempt)
+
     def save(self, path: str, mode: str = "all",
              table: Optional[str] = None, keys=None) -> int:
         """Durable dump — at n > 1 fans out into per-shard
         ``shard-<k:03d>/`` subdirs of ``path`` (ps/cluster.cluster_save);
         EVERY shard writes its DEDUP.bin there, so all N restart handoffs
         stay current.  n == 1 keeps the flat single-server layout."""
-        return ps_cluster.cluster_save(self, path, mode=mode, keys=keys,
-                                       table=table)
+        return self._control_fenced(
+            lambda: ps_cluster.cluster_save(self, path, mode=mode,
+                                            keys=keys, table=table))
 
     def load(self, path: str, table: Optional[str] = None,
              mode: str = "replace") -> int:
-        return ps_cluster.cluster_load(self, path, mode=mode, table=table)
+        return self._control_fenced(
+            lambda: ps_cluster.cluster_load(self, path, mode=mode,
+                                            table=table))
 
     def shrink(self, table: Optional[str] = None) -> int:
-        if self.n_shards > 1:
-            return sum(
-                int(self._call({"cmd": "shrink", "table": table},
-                               shard=s)["removed"])
-                for s in range(self.n_shards))
-        return self._call({"cmd": "shrink", "table": table})["removed"]
+        def run():
+            if self.n_shards > 1:
+                return sum(
+                    int(self._call(self._stamp_ep(
+                        {"cmd": "shrink", "table": table}),
+                        shard=s)["removed"])
+                    for s in range(self.n_shards))
+            return self._call(self._stamp_ep(
+                {"cmd": "shrink", "table": table}))["removed"]
+        return self._control_fenced(run)
 
     def end_day(self, table: Optional[str] = None) -> None:
         # non-idempotent (counter decay) → exactly-once via rid; cluster-
         # wide it is 2-phase over every shard's dedup window — ALL shards
         # decay or none (ps/cluster.two_phase_lifecycle; lint rule PB801
         # keeps every lifecycle send on this path)
-        ps_cluster.two_phase_lifecycle(self, "end_day", table=table)
+        self._control_fenced(
+            lambda: ps_cluster.two_phase_lifecycle(self, "end_day",
+                                                   table=table))
 
     def size(self, table: Optional[str] = None) -> int:
         if self.n_shards > 1:
@@ -1816,12 +2617,22 @@ class PSClient:
         """Serving-tier ragged inference pool (ps/serving.py): per-sample
         sum over [embed_w | mf] of each sample's keys, ``lod`` = n+1
         offsets into ``keys``.  Single-frame (serving batches are small
-        by construction; the admission cap bounds them server-side)."""
-        resp = self._call({"cmd": "forward",
-                           "keys": np.asarray(keys, np.uint64),
-                           "lod": np.asarray(lod, np.int64),
-                           "table": table})
-        return resp["pooled"]
+        by construction; the admission cap bounds them server-side).
+        Read-only, so a fence redirect is a simple refresh-and-redo."""
+        bo = Backoff(base=self.retry_sleep, cap=self.backoff_cap,
+                     deadline=self.deadline)
+        attempt = 0
+        while True:
+            try:
+                resp = self._call(self._stamp_ep(
+                    {"cmd": "forward",
+                     "keys": np.asarray(keys, np.uint64),
+                     "lod": np.asarray(lod, np.int64),
+                     "table": table}))
+                return resp["pooled"]
+            except _FenceRedirect as e:
+                attempt += 1
+                self._fence_recover(e, bo, attempt)
 
     def invalidate_row_width(self, table: Optional[str] = None) -> None:
         """Drop learned row-width estimates (one table, or all when

@@ -28,7 +28,18 @@ a reason for caller code to hand-build these frames.
          Route through a single sharded client (whose methods fan out
          cluster-wide) instead.
 
-``ps/cluster.py`` (the implementation) and test files are exempt.
+  PB803  hand-built fleet membership: a direct ``ServerMap(...)``
+         construction, or an assignment to a ``.addrs`` / ``.epoch``
+         attribute, outside the sanctioned modules.  With elastic
+         membership the epoch IS the routing fence — a map invented (or
+         mutated) outside ps/cluster.py's ``make_server_map`` /
+         ``map_from_desc`` and ps/reshard.py's cutover can carry a
+         stale or colliding epoch, and every server it reaches will
+         either reject the traffic (wrong_epoch) or, worse, accept
+         writes addressed by a partition no one else agrees on.
+
+``ps/cluster.py`` and ``ps/reshard.py`` (the implementations) and test
+files are exempt.
 """
 
 from __future__ import annotations
@@ -43,7 +54,8 @@ _SEND_NAMES = ("_call", "_call_attempts")
 _CLUSTER_VERBS = ("end_day", "lifecycle_prepare", "lifecycle_commit",
                   "lifecycle_abort", "save", "load")
 _MEMBER_VERBS = ("end_day", "save", "load")
-_EXEMPT_PATHS = ("/ps/cluster.py",)
+_EXEMPT_PATHS = ("/ps/cluster.py", "/ps/reshard.py")
+_MAP_ATTRS = ("addrs", "epoch")
 
 
 def _send_name(func: ast.AST) -> str:
@@ -108,4 +120,27 @@ def check(mod: Module, ctx: PackageContext) -> List[Finding]:
                 "single-shard lifecycle send forks the cluster — call it "
                 "on the sharded client (which fans out 2-phase / through "
                 "the cluster MANIFEST) instead"))
+        if _send_name(node.func) == "ServerMap":
+            findings.append(Finding(
+                mod.path, node.lineno, "PB803",
+                "hand-built ServerMap: construct fleet membership via "
+                "ps/cluster.py make_server_map / map_from_desc (or let "
+                "ps/reshard.py's cutover mint the next epoch) — a map "
+                "invented here can carry a stale or colliding epoch and "
+                "break the routing fence"))
+    for node in ast.walk(mod.tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and t.attr in _MAP_ATTRS:
+                findings.append(Finding(
+                    mod.path, t.lineno, "PB803",
+                    f"mutating membership field '.{t.attr}' in place: "
+                    "ServerMaps are immutable once published — route "
+                    "changes through ps/reshard.py's epoch-bumped "
+                    "cutover (or make_server_map for a fresh fleet) so "
+                    "every client and server agrees on the fence"))
     return findings

@@ -1250,3 +1250,61 @@ def test_pb801_suppression_escape():
         client._call({"cmd": "end_day", "table": None})
     """
     assert codes(src) == []
+
+
+def test_pb803_hand_built_server_map():
+    src = """
+    def fleet_map(addrs):
+        return ServerMap(addrs, epoch=3)
+    """
+    assert codes(src) == ["PB803"]
+
+
+def test_pb803_membership_attr_mutation():
+    src = """
+    def bump(m, addrs):
+        m.epoch = m.epoch + 1
+        m.addrs = addrs
+    """
+    assert codes(src) == ["PB803", "PB803"]
+
+
+def test_pb803_augassign_epoch():
+    src = """
+    def bump(self):
+        self.epoch += 1
+    """
+    assert codes(src) == ["PB803"]
+
+
+def test_pb803_sanctioned_constructors_and_reads_ok():
+    # make_server_map / map_from_desc are the sanctioned routes, and
+    # READING the membership fields is how routing is supposed to work
+    src = """
+    def route(client, desc, addrs, keys):
+        m = make_server_map(addrs, epoch=0)
+        m2 = map_from_desc(desc)
+        if m2.epoch > m.epoch:
+            client._adopt_map(m2)
+        return m2.addrs, m2.partition(keys)
+    """
+    assert codes(src) == []
+
+
+def test_pb803_impl_modules_and_tests_exempt():
+    src = """
+    def mint(addrs, e):
+        return ServerMap(addrs, epoch=e)
+    """
+    assert codes(src, path="paddlebox_tpu/ps/cluster.py") == []
+    assert codes(src, path="paddlebox_tpu/ps/reshard.py") == []
+    assert codes(src, path="tests/test_ps_reshard.py") == []
+
+
+def test_pb803_suppression_escape():
+    src = """
+    def mirror(self, n):
+        # pboxlint: disable-next=PB803 -- fleet-level epoch mirror
+        self.epoch = n
+    """
+    assert codes(src) == []
