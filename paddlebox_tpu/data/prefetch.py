@@ -221,6 +221,7 @@ class PassPrefetcher:
         feed = self.trainer.finish_pass_feed(arrays,
                                              keep_host=spec.keep_host)
         with self._cond:          # frees the worker to open the next feed
+            lockdep.guards(self, "_adopted_n")
             self._adopted_n += 1
             self._cond.notify_all()
         self._last_dataset = dataset

@@ -169,6 +169,7 @@ class TcpShuffleTransport(ShuffleTransport):
             # a corrupt frame means lost records — poison the barrier so
             # the pass FAILS loudly instead of hanging or training short
             with self._done_cv:
+                lockdep.guards(self, "_rx_error")
                 self._rx_error = e
                 self._done_cv.notify_all()
             return

@@ -562,12 +562,15 @@ class PSServerSupervisor:
             try:
                 # the dying instance's membership may be AHEAD of what
                 # this supervisor was constructed with (a reshard cutover
-                # adopted a newer epoch) — carry the latest forward
+                # adopted a newer epoch) — carry the latest forward,
+                # snapshotted atomically so a cutover racing the restart
+                # cannot pair the new map with the old shard index
+                membership, shard, _ = old._membership_view()
                 self.server = self._make(self.table, host=self.host,
                                          port=self.port,
                                          dedup_state=dedup,
-                                         membership=old.membership,
-                                         shard=old.shard)
+                                         membership=membership,
+                                         shard=shard)
                 break
             except OSError:
                 # the dead listener's port may still be draining

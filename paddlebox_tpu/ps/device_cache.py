@@ -336,11 +336,16 @@ class DeviceRowCache:
         cand_mask = np.ones((n,), bool)
         cand_mask[res_idx] = False
         cand = np.flatnonzero(cand_mask)
-        if self._server_map is not None and self._device_world > 1:
+        # topology trio is co-mutated under _lock (attach_server_map /
+        # update_server_map); a bare triple read could pair a new map
+        # with the old rank/world mid-adopt — snapshot atomically (PB902)
+        with self._lock:
+            smap = self._server_map
+            rank, world = self._device_rank, self._device_world
+        if smap is not None and world > 1:
             # sharded topology: only admit this device's owned slice of
             # the key space (same ServerMap placement the wire uses)
-            owned = (self._server_map.shard_of_keys(keys[cand])
-                     % self._device_world) == self._device_rank
+            owned = (smap.shard_of_keys(keys[cand]) % world) == rank
             cand = cand[owned]
         order = np.lexsort((keys[cand], -scores[cand]))
         cand = cand[order]
@@ -406,6 +411,7 @@ class DeviceRowCache:
         kocc = self._slot_key[occ]
         korder = np.argsort(kocc, kind="stable")
         with self._lock:
+            lockdep.guards(self, "_keys")
             self._keys = kocc[korder]
             self._slots = occ[korder]
         stat_set("ps.cache.resident_rows", float(len(occ)))

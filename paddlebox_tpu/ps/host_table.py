@@ -196,6 +196,7 @@ class _Shard:
                 need = self._len + len(new_keys)
                 if need > len(self._keys_buf):
                     self._grow(need)
+                lockdep.guards(self, "_len")
                 self._keys_buf[self._len:need] = new_keys
                 for f, buf in self._soa_buf.items():
                     buf[self._len:need] = soa[f][~found]

@@ -431,7 +431,7 @@ class PSServer:
         # in it (-1 = not a member — a retiring source after cutover, or a
         # joining destination before it).  Fenced sparse verbs are checked
         # against these; ps/reshard.py changes them via reshard_cutover.
-        self.membership: Optional[ps_cluster.ServerMap] = None
+        self.membership: Optional[ps_cluster.ServerMap] = None  # pboxlint: guarded-by=ps.service.PSServer._reshard_lock
         if membership is not None:
             self.membership = (membership
                                if isinstance(membership, ps_cluster.ServerMap)
@@ -625,6 +625,15 @@ class PSServer:
         return t
 
     # -- elastic membership fence -------------------------------------------
+    def _membership_view(self):
+        """Atomic (membership, shard, reshard) snapshot.  The trio is
+        co-mutated under ``_reshard_lock`` in ``_adopt_membership``;
+        reading the three words bare can observe the new map with the
+        old shard index mid-cutover — every multi-word reader goes
+        through this instead (PB902)."""
+        with self._reshard_lock:
+            return self.membership, self.shard, self._reshard
+
     def _fence(self, req: Dict) -> None:
         """Epoch + ownership check for a fenced sparse verb.  Runs AFTER
         the dedup echo (an applied duplicate replays its cached ack first)
@@ -633,7 +642,7 @@ class PSServer:
         cleanly.  Ordering: epoch first (a stale client must refresh
         before ownership means anything), then ownership, then the
         migration freeze (writes into a frozen moving range)."""
-        m = self.membership
+        m, shard, rs = self._membership_view()
         ep = req.get(EPOCH_FIELD)
         if ep is None:
             # unfenced legacy frame: serve while no reshard ever happened,
@@ -650,7 +659,7 @@ class PSServer:
             # (the cutover commit fan-out is still reaching us)
             stat_add("ps.server.fence_wrong_epoch")
             raise FenceError("wrong_epoch", m)
-        if self.shard < 0:
+        if shard < 0:
             # epoch matched but this server left the fleet (owned_mask
             # degenerates to all-True at n == 1, so check explicitly)
             stat_add("ps.server.fence_not_owner")
@@ -659,10 +668,9 @@ class PSServer:
         if keys is not None and m.n > 1:
             keys = np.asarray(keys, np.uint64)
             if len(keys) and not ps_cluster.owned_mask(
-                    keys, self.shard, m.n).all():
+                    keys, shard, m.n).all():
                 stat_add("ps.server.fence_not_owner")
                 raise FenceError("not_owner", m)
-        rs = self._reshard
         if rs is not None and rs["frozen"] \
                 and req["cmd"] in ("push_sparse", "push_sparse_delta"):
             # cutover freeze: only WRITES touching the moving range block
@@ -723,17 +731,17 @@ class PSServer:
         CURRENT membership — the cleanup that makes abandoned-migration
         ingest (rows upserted into a destination before an abort)
         invisible to later snapshots and to the union fleet state."""
-        m = self.membership
+        m, shard, _rs = self._membership_view()
         if m is None:
             return 0
         removed = 0
         for t in self.tables.values():
-            if self.shard < 0:
+            if shard < 0:
                 removed += t.filter_keys(
                     lambda k: np.zeros(len(k), bool))
             elif m.n > 1:
                 removed += t.filter_keys(
-                    lambda k: ps_cluster.owned_mask(k, self.shard, m.n))
+                    lambda k: ps_cluster.owned_mask(k, shard, m.n))
         return removed
 
     def _adopt_membership(self, desc: Dict, assign: Optional[Dict]) -> bool:
@@ -972,6 +980,7 @@ class PSServer:
             else:
                 self._table(req)  # raises on unknown table before staging
             with self._staged_lock:
+                lockdep.guards(self, "_staged")
                 self._staged[req["txn"]] = {"verb": verb,
                                             "table": req.get("table")}
             stat_add("ps.server.lifecycle_prepare")
@@ -1109,13 +1118,14 @@ class PSServer:
                    "tables": ",".join(sorted(self.tables)),
                    "stats": {k: float(v)
                              for k, v in stat_snapshot("ps.").items()}}
-            if self.membership is not None:
+            m, shard, rs = self._membership_view()
+            if m is not None:
                 # membership authority surface: clients refresh their
                 # ServerMap from ANY live member's health (shard 0
                 # preferred, falling through dead entries)
-                out["membership"] = self.membership.describe()
-                out["shard"] = self.shard
-                out["migrating"] = self._reshard is not None
+                out["membership"] = m.describe()
+                out["shard"] = shard
+                out["migrating"] = rs is not None
             return out
         if cmd == "barrier":
             world = req["world"]
@@ -2263,11 +2273,14 @@ class PSClient:
     def _push_sparse_once(self, keys: np.ndarray,
                           rows: Dict[str, np.ndarray],
                           table: Optional[str]):
-        if self.n_shards > 1 and len(keys):
+        # single-reference snapshot: server_map/n_shards are co-mutated
+        # under _pool_cv in _adopt_map — partitioning with one and
+        # counting with the other mid-adopt would mis-route keys (PB902)
+        sm = self.server_map
+        if sm.n > 1 and len(keys):
             per_row = self._rows_bytes(rows)
             reqs_by_shard: Dict[int, List[Dict]] = {}
-            for shard, p in enumerate(
-                    self.server_map.partition(keys)):
+            for shard, p in enumerate(sm.partition(keys)):
                 if not len(p):
                     continue
                 stat_add(f"ps.cluster.s{shard}.push_keys",

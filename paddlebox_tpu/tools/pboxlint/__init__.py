@@ -10,15 +10,24 @@ interpreter, so this package supplies the equivalent as lint passes over
   PB2xx  flag hygiene           (tools/pboxlint/flags_hygiene.py)
          + metric/span name hygiene, PB204
            (tools/pboxlint/metric_names.py)
+         + SLO rule coverage, PB207 (tools/pboxlint/slo_rules.py)
   PB3xx  JAX purity             (tools/pboxlint/purity.py)
   PB4xx  threading lifecycle    (tools/pboxlint/lifecycle.py)
   PB5xx  retry/backoff          (tools/pboxlint/retries.py)
          + durable-write atomicity, PB502
            (tools/pboxlint/atomic_io.py)
+         + device-cache mutation scope, PB503
+           (tools/pboxlint/device_cache.py)
+  PB6xx  lock-order graph       (tools/pboxlint/lockgraph.py)
+  PB7xx  serving read path      (tools/pboxlint/serving_path.py)
+  PB8xx  cluster commit safety  (tools/pboxlint/cluster_commit.py)
+  PB9xx  guarded-by inference / data races
+                                (tools/pboxlint/raceguard.py)
 
 CLI::
 
     python -m paddlebox_tpu.tools.pboxlint paddlebox_tpu/
+    python -m paddlebox_tpu.tools.pboxlint --select=PB9xx --stats paddlebox_tpu/
 
 emits ``file:line: PBnnn message`` per finding and exits nonzero when any
 survive suppression.  Suppress a deliberate exception precisely::

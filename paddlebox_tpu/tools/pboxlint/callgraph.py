@@ -284,7 +284,7 @@ class PackageGraph:
                         ctor = self._class_from_ctor(mod, v)
                         if ctor:
                             out[t.id] = ctor
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if not isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue

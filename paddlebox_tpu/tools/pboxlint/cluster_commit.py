@@ -96,7 +96,7 @@ def check(mod: Module, ctx: PackageContext) -> List[Finding]:
             or mod.basename.startswith("test_"):
         return []
     findings: List[Finding] = []
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         if _send_name(node.func) in _SEND_NAMES:
@@ -128,7 +128,7 @@ def check(mod: Module, ctx: PackageContext) -> List[Finding]:
                 "ps/reshard.py's cutover mint the next epoch) — a map "
                 "invented here can carry a stale or colliding epoch and "
                 "break the routing fence"))
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         targets = []
         if isinstance(node, ast.Assign):
             targets = node.targets

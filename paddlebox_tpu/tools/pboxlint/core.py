@@ -38,6 +38,8 @@ class Module:
         self.path = path
         self.source = source
         self.tree = ast.parse(source, filename=path)
+        self._nodes: Optional[Tuple[ast.AST, ...]] = None
+        self._by_type: Dict[type, Tuple[ast.AST, ...]] = {}
         # line -> set of suppressed codes ("ALL" suppresses everything)
         self.suppressions: Dict[int, Set[str]] = {}
         for lineno, text in enumerate(source.splitlines(), 1):
@@ -54,6 +56,27 @@ class Module:
     @property
     def basename(self) -> str:
         return os.path.basename(self.path)
+
+    def walk(self) -> Tuple[ast.AST, ...]:
+        """Whole-tree node list, materialized ONCE per module — the
+        shared-AST pass.  14 checkers each doing ``ast.walk(mod.tree)``
+        (several more than once) re-traverse the same tree ~40×; they
+        iterate this cache instead.  Order matches ``ast.walk`` (BFS),
+        so existing checker logic is unaffected."""
+        nodes = self._nodes
+        if nodes is None:
+            nodes = self._nodes = tuple(ast.walk(self.tree))
+        return nodes
+
+    def nodes_of(self, node_type: type) -> Tuple[ast.AST, ...]:
+        """``walk()`` filtered to one node type (isinstance), cached —
+        the common shape ``for n in ast.walk(tree): if isinstance(n, T)``
+        collapses to a pre-bucketed tuple."""
+        got = self._by_type.get(node_type)
+        if got is None:
+            got = self._by_type[node_type] = tuple(
+                n for n in self.walk() if isinstance(n, node_type))
+        return got
 
     def suppressed(self, finding: Finding) -> bool:
         codes = self.suppressions.get(finding.line)
@@ -73,7 +96,7 @@ class PackageContext:
         self.dynamic_flag_defs = False    # define_flag with non-literal name
         self.dynamic_flag_reads = False   # get_flags with non-literal name
         for mod in self.modules:
-            for node in ast.walk(mod.tree):
+            for node in mod.walk():
                 if not isinstance(node, ast.Call):
                     continue
                 tail = _call_name(node).rsplit(".", 1)[-1]
@@ -138,27 +161,64 @@ def ALL_CHECKERS():
                                               device_cache, flags_hygiene,
                                               flight_events, lifecycle,
                                               lockgraph, locks, metric_names,
-                                              purity, retries, serving_path,
-                                              slo_rules)
+                                              purity, raceguard, retries,
+                                              serving_path, slo_rules)
     return (locks.check, flags_hygiene.check, metric_names.check,
             flight_events.check, purity.check, lifecycle.check,
             retries.check, atomic_io.check, device_cache.check,
-            lockgraph.check, slo_rules.check, serving_path.check,
-            cluster_commit.check)
+            lockgraph.check, raceguard.check, slo_rules.check,
+            serving_path.check, cluster_commit.check)
 
 
-def lint_modules(modules: Sequence[Module]) -> List[Finding]:
+def select_matches(code: str, select: Optional[Sequence[str]]) -> bool:
+    """``--select`` semantics: ``PB901`` matches exactly; a family token
+    ending in ``xx`` (``PB9xx``, ``PB6XX``) is a prefix match.  ``None``
+    or empty selects everything."""
+    if not select:
+        return True
+    for tok in select:
+        tok = tok.strip().upper()
+        if not tok:
+            continue
+        if tok.endswith("XX"):
+            if code.upper().startswith(tok[:-2]):
+                return True
+        elif code.upper() == tok:
+            return True
+    return False
+
+
+def lint_modules(modules: Sequence[Module],
+                 select: Optional[Sequence[str]] = None,
+                 stats: Optional[Dict[str, float]] = None) -> List[Finding]:
+    """Single shared pass: every module is parsed ONCE (in Module) and
+    every cross-module analysis (flag registry, callgraph, lockgraph,
+    raceguard) is built ONCE on the shared PackageContext — checkers
+    cache on ``ctx``.  ``stats`` (if given) accumulates per-checker
+    seconds; shared-analysis build cost lands on whichever checker runs
+    first (lockgraph pays the fixpoint, raceguard rides the cache)."""
+    import time
+
     ctx = PackageContext(modules)
     findings: List[Finding] = []
     for mod in modules:
         for check in ALL_CHECKERS():
-            findings.extend(f for f in check(mod, ctx)
-                            if not mod.suppressed(f))
+            t0 = time.perf_counter() if stats is not None else 0.0
+            found = check(mod, ctx)
+            if stats is not None:
+                key = check.__module__.rsplit(".", 1)[-1]
+                stats[key] = stats.get(key, 0.0) \
+                    + (time.perf_counter() - t0)
+            findings.extend(f for f in found
+                            if not mod.suppressed(f)
+                            and select_matches(f.code, select))
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     return findings
 
 
-def lint_paths(paths: Sequence[str]
+def lint_paths(paths: Sequence[str],
+               select: Optional[Sequence[str]] = None,
+               stats: Optional[Dict[str, float]] = None
                ) -> Tuple[List[Finding], List[Tuple[str, str]]]:
     """→ (findings, [(path, parse-error)])."""
     modules: List[Module] = []
@@ -170,14 +230,15 @@ def lint_paths(paths: Sequence[str]
             modules.append(Module(path, src))
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             errors.append((path, repr(e)))
-    return lint_modules(modules), errors
+    return lint_modules(modules, select=select, stats=stats), errors
 
 
 def lint_source(source: str, path: str = "<snippet>",
-                extra: Optional[Sequence[Module]] = None) -> List[Finding]:
+                extra: Optional[Sequence[Module]] = None,
+                select: Optional[Sequence[str]] = None) -> List[Finding]:
     """Lint one source string (unit-test surface for checker snippets)."""
     mods = [Module(path, source)] + list(extra or [])
-    return [f for f in lint_modules(mods) if f.path == path]
+    return [f for f in lint_modules(mods, select=select) if f.path == path]
 
 
 def baseline_counts(findings: Sequence[Finding]) -> Dict[str, int]:
@@ -197,12 +258,18 @@ usage: python -m paddlebox_tpu.tools.pboxlint [options] <file-or-dir> [...]
 
 options:
   --format=text|json   output format (json: {findings, errors, counts})
+  --select=CODES       only report the given codes/families, e.g.
+                       --select=PB901,PB6xx (a token ending in "xx" is a
+                       family prefix; composes with --baseline and both
+                       formats — counts/baselines see the filtered set)
   --baseline FILE      compare against a saved baseline (json produced by
                        --format=json, or just its "counts" object); exit 1
                        only on findings NEW relative to the baseline
   --write-baseline FILE
                        write the current per-file/per-code counts to FILE
                        (and exit by the normal rules)
+  --stats              report per-checker wall time (text: to stderr;
+                       json: a "stats" object of seconds)
 
 exit codes:
   0  clean (or, with --baseline, no new findings)
@@ -222,6 +289,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     fmt = "text"
     baseline_path: Optional[str] = None
     write_baseline: Optional[str] = None
+    select: Optional[List[str]] = None
+    want_stats = False
     paths: List[str] = []
     i = 0
     while i < len(args):
@@ -231,6 +300,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             if fmt not in ("text", "json"):
                 print(f"pboxlint: unknown format {fmt!r}", file=sys.stderr)
                 return 2
+        elif a.startswith("--select=") or (a == "--select"
+                                           and i + 1 < len(args)):
+            if a == "--select":
+                i += 1
+                raw = args[i]
+            else:
+                raw = a.split("=", 1)[1]
+            select = [t for t in re.split(r"[,\s]+", raw) if t]
+            if not select:
+                print("pboxlint: --select needs at least one code",
+                      file=sys.stderr)
+                return 2
+        elif a == "--stats":
+            want_stats = True
         elif a == "--baseline" and i + 1 < len(args):
             i += 1
             baseline_path = args[i]
@@ -247,7 +330,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(_USAGE, file=sys.stderr)
         return 2
 
-    findings, errors = lint_paths(paths)
+    stats: Optional[Dict[str, float]] = {} if want_stats else None
+    findings, errors = lint_paths(paths, select=select, stats=stats)
     counts = baseline_counts(findings)
 
     new_keys: List[str] = []
@@ -268,17 +352,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                           if n > int(base_counts.get(k, 0)))
 
     if fmt == "json":
-        print(json.dumps({
+        out = {
             "findings": [dataclasses.asdict(f) for f in findings],
             "errors": [{"path": p, "error": e} for p, e in errors],
             "counts": counts,
             "new": new_keys,
-        }, indent=2, sort_keys=True))
+        }
+        if stats is not None:
+            out["stats"] = {k: round(v, 4) for k, v in stats.items()}
+        print(json.dumps(out, indent=2, sort_keys=True))
     else:
         for path, err in errors:
             print(f"{path}:0: PB000 parse failure: {err}")
         for f in findings:
             print(f.render())
+        if stats is not None:
+            total = sum(stats.values())
+            for k in sorted(stats, key=stats.get, reverse=True):
+                print(f"pboxlint: stats: {k:<14} {stats[k]:7.3f}s",
+                      file=sys.stderr)
+            print(f"pboxlint: stats: {'TOTAL':<14} {total:7.3f}s",
+                  file=sys.stderr)
 
     if write_baseline is not None:
         with open(write_baseline, "w", encoding="utf-8") as f:

@@ -53,7 +53,7 @@ def _literal(node: ast.AST) -> Optional[Any]:
 def check(mod: Module, ctx: PackageContext) -> List[Finding]:
     findings: List[Finding] = []
     is_flags_module = mod.basename == "flags.py"
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         name = dotted_name(node.func)
@@ -125,7 +125,7 @@ def check(mod: Module, ctx: PackageContext) -> List[Finding]:
                     f"set_flags apply"))
 
     if not is_flags_module:
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if (isinstance(node, ast.Subscript)
                     and dotted_name(node.value) == "os.environ"
                     and isinstance(node.slice, ast.Constant)

@@ -38,7 +38,7 @@ _FLIGHT_MOD = "paddlebox_tpu.utils.flight"
 def _record_sinks(mod: Module) -> Set[str]:
     """Dotted call names in this module that resolve to flight.record."""
     sinks: Set[str] = set()
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.Import):
             for alias in node.names:
                 if alias.name == _FLIGHT_MOD:
@@ -106,7 +106,7 @@ def check(mod: Module, ctx: PackageContext) -> List[Finding]:
     if not sinks:
         return []
     findings: List[Finding] = []
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not (isinstance(node, ast.Call) and node.args):
             continue
         if dotted_name(node.func) not in sinks:

@@ -97,7 +97,7 @@ def _check_threads(mod: Module) -> List[Finding]:
     # owning scope for a `self.X` thread is its innermost class; for a
     # local, the innermost function (module body otherwise).
     parent = {}
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         for child in ast.iter_child_nodes(node):
             parent[child] = node
 
@@ -112,7 +112,7 @@ def _check_threads(mod: Module) -> List[Finding]:
             cur = parent.get(cur)
         return mod.tree
 
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.Assign) and _is_thread_ctor(node.value):
             call = node.value
             for name, is_self in map(_target_name, node.targets):
@@ -163,7 +163,7 @@ def _thread_target_def(mod: Module, call: ast.Call) -> Optional[ast.AST]:
             name = v.attr
         else:
             return None
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
                     and node.name == name):
                 return node
@@ -178,7 +178,7 @@ def _check_recurring_threads(mod: Module) -> List[Finding]:
     """PB405 — recurring work on a raw unjoined thread."""
     findings: List[Finding] = []
     parent = {}
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         for child in ast.iter_child_nodes(node):
             parent[child] = node
 
@@ -202,7 +202,7 @@ def _check_recurring_threads(mod: Module) -> List[Finding]:
             f"lifecycle); suppress with a reason for deliberate "
             f"long-lived pumps"))
 
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.Assign) and _is_thread_ctor(node.value):
             call = node.value
             fn = _thread_target_def(mod, call)
@@ -230,7 +230,7 @@ def _queue_names(mod: Module) -> Set[str]:
     """Names (attr or local, unqualified) assigned from a queue ctor
     anywhere in the module."""
     out: Set[str] = set()
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not (isinstance(node, (ast.Assign, ast.AnnAssign))
                 and node.value is not None
                 and isinstance(node.value, ast.Call)):
@@ -269,7 +269,7 @@ def _check_queue_gets(mod: Module) -> List[Finding]:
     if not queues:
         return []
     findings: List[Finding] = []
-    for loop in ast.walk(mod.tree):
+    for loop in mod.walk():
         if not isinstance(loop, ast.While):
             continue
         for node in ast.walk(loop):
@@ -307,7 +307,7 @@ def _is_executor_ctor(node: ast.AST) -> bool:
 def _check_executors(mod: Module) -> List[Finding]:
     findings: List[Finding] = []
     parent = {}
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         for child in ast.iter_child_nodes(node):
             parent[child] = node
 
@@ -324,13 +324,13 @@ def _check_executors(mod: Module) -> List[Finding]:
 
     # ctors managed by a `with` statement: shutdown is implicit
     with_managed = set()
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, (ast.With, ast.AsyncWith)):
             for item in node.items:
                 if _is_executor_ctor(item.context_expr):
                     with_managed.add(id(item.context_expr))
 
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not _is_executor_ctor(node):
             continue
         call = node

@@ -421,7 +421,7 @@ def _check_io_under_lock(mod: Module) -> List[Finding]:
             walker.visit(stmt)
         findings.extend(walker.findings)
 
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.ClassDef):
             info = _ClassInfo(node)
             for name, m in info.methods.items():
@@ -434,7 +434,7 @@ def _check_io_under_lock(mod: Module) -> List[Finding]:
 
 def _check_bare_acquire(mod: Module) -> List[Finding]:
     findings: List[Finding] = []
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         body_lists = [getattr(node, f, None)
                       for f in ("body", "orelse", "finalbody")]
         for body in body_lists:
@@ -468,7 +468,7 @@ def _check_bare_acquire(mod: Module) -> List[Finding]:
 
 def check(mod: Module, ctx: PackageContext) -> List[Finding]:
     findings: List[Finding] = []
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.ClassDef):
             findings.extend(_check_class(mod, node))
     findings.extend(_check_bare_acquire(mod))

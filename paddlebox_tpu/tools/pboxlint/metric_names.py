@@ -124,7 +124,7 @@ def _findings_for_name(mod: Module, call: ast.Call,
 
 def check(mod: Module, ctx: PackageContext) -> List[Finding]:
     findings: List[Finding] = []
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not (isinstance(node, ast.Call) and node.args):
             continue
         tail = dotted_name(node.func).rsplit(".", 1)[-1]

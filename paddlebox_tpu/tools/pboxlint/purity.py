@@ -61,7 +61,7 @@ def _is_jit_reference(node: ast.AST) -> bool:
 def _collect_traced(mod: Module) -> List[ast.AST]:
     """Function nodes whose bodies execute under tracing."""
     defs_by_name: Dict[str, List[ast.AST]] = {}
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             defs_by_name.setdefault(node.name, []).append(node)
 
@@ -73,7 +73,7 @@ def _collect_traced(mod: Module) -> List[ast.AST]:
             seen.add(id(node))
             traced.append(node)
 
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             if any(_is_jit_reference(d) for d in node.decorator_list):
                 mark(node)

@@ -1,0 +1,834 @@
+"""PB9xx — guarded-by inference + whole-program data-race detection.
+
+The Eraser recipe (Savage et al.), run statically over the package: for
+every class attribute, collect every load/store site together with the
+set of locks held at that site, and **infer the guarding lock as the
+intersection of the locksets at post-construction mutation sites**.  A
+field whose locked mutation sites agree on a lock is *guarded*; accesses
+that break the discipline are the race classes:
+
+  PB901  write with an empty/inconsistent lockset on a field that is
+         guarded elsewhere — the classic lost-update/torn-invariant
+         write.  An explicit ``# pboxlint: guarded-by=pkg.Cls._lock``
+         annotation (on the field's assignment line, or on a class-body
+         declaration) overrides inference and makes EVERY unguarded
+         write a finding.
+  PB902  read of a multi-word invariant outside its lock: two fields
+         co-mutated inside one critical section form an invariant; a
+         function reading both with the lock not held can observe the
+         torn intermediate state.
+  PB903  escape of a guarded container/array reference out of its
+         critical section — ``return self._rows`` hands the caller a
+         live alias that outlives the lock; return a copy or a frozen
+         view instead.
+  PB904  thread-spawned callable (``Thread(target=)``, ``pool.submit``,
+         ``pool.map``) that reaches a write or container access of a
+         guarded field with no lock held on any path from the spawn —
+         the caller's locks never flow into a spawned task.
+
+Locksets are interprocedural: a function's *entry-held* set is the
+intersection (meet) over every in-package call site of the caller's
+lockset there, so a private helper only ever called under the table
+lock analyzes as holding it.  Spawn edges contribute the empty set
+(a new thread starts with nothing), and dynamic calls WIDEN (CHA over
+same-named methods, capped like lockgraph) — the caller's held-set is
+never dropped through a call the resolver cannot pin down.
+
+Soundness model — benign publication idioms that must NOT be findings:
+
+  * constructor-only writes: ``__init__``/``__new__`` and private
+    helpers reachable only from them run before the instance is shared;
+    their writes neither infer guards nor violate them.
+  * immutable-after-publish (freeze points): a field never mutated
+    after construction has no mutation sites, hence no guard and no
+    findings — ``FrozenHostTable``-style objects are clean by
+    construction.
+  * atomic-flag idioms: a bare store of a literal ``True``/``False``/
+    ``None`` is a single-word publish (atomic under the GIL) and is not
+    a PB901 unless the field carries an explicit guarded-by annotation.
+  * single-word bare reads are snapshots (GIL-atomic reference loads)
+    — only multi-word reads (PB902) and container traffic race.
+  * ``threading.local()`` fields are per-thread by definition.
+
+The inferred map doubles as the **runtime contract**: ``guard_map()``
+exports ``{"ps.service.PSServer._staged": ["ps.service.PSServer.
+_staged_lock"], ...}`` in the same class-fingerprint namespace the
+``utils/lockdep.py`` guards witness reports, so tier-1 can assert every
+runtime-observed (site, held-locks) pair is contained in the static map
+— the cross-validation contract that made PB6xx trustworthy.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from paddlebox_tpu.tools.pboxlint import callgraph, lockgraph
+from paddlebox_tpu.tools.pboxlint.core import (Finding, Module,
+                                               PackageContext, dotted_name)
+
+_GUARDED_BY_RE = re.compile(
+    r"#\s*pboxlint:\s*guarded-by\s*=\s*(?P<fp>[A-Za-z0-9_.]+)")
+
+# container constructors whose product is a mutable shared structure —
+# the PB903 escape classes (numpy arrays included: views alias storage)
+_CONTAINER_CTORS = {"list", "dict", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter", "bytearray",
+                    "zeros", "empty", "ones", "full", "array", "arange"}
+# calls that produce a fresh object — returning these is NOT an escape
+_COPY_CALLS = {"list", "dict", "set", "tuple", "sorted", "frozenset",
+               "bytes", "copy", "deepcopy", "min", "max", "sum", "len"}
+_MUTATOR_METHODS = {"append", "extend", "insert", "add", "update",
+                    "setdefault", "pop", "popleft", "popitem", "remove",
+                    "discard", "clear", "sort", "reverse", "fill"}
+
+_WIDEN_FANOUT_CAP = lockgraph._WIDEN_FANOUT_CAP
+
+
+@dataclasses.dataclass
+class _Access:
+    """One load/store of ``<recv>.<attr>`` where recv's class is known."""
+    cq: str                    # receiver class qname ("ps.host_table._Shard")
+    attr: str
+    line: int
+    kind: str                  # "read" | "write"
+    held: Tuple[str, ...]      # locks held LOCALLY at the site (fixpoint
+    #                            adds the function's entry-held set)
+    const_store: bool = False  # write of a literal True/False/None
+    container_op: bool = False  # subscript store / mutator-method / iteration
+
+
+@dataclasses.dataclass
+class _Escape:
+    """``return self.X`` / ``yield self.X`` of the bare reference."""
+    cq: str
+    attr: str
+    line: int
+
+
+class _FnAccesses:
+    def __init__(self) -> None:
+        self.accesses: List[_Access] = []
+        self.escapes: List[_Escape] = []
+
+
+class _AccessWalker(ast.NodeVisitor):
+    """lockgraph's W-visitor shape, tracking held locks through ``with``
+    blocks, but recording attribute loads/stores instead of call sites.
+    Nested defs are their own summaries and are skipped."""
+
+    def __init__(self, analysis: "RaceAnalysis", fn: "callgraph.FuncInfo"):
+        self.an = analysis
+        self.fn = fn
+        self.local_types = analysis.la.graph._local_types(fn)
+        self.out = _FnAccesses()
+        self.held: List[str] = []
+        # escape-analysis lite: a local assigned a fresh package-class
+        # ctor IN THIS BODY is unshared — accesses through it cannot
+        # race and must not pollute guard inference
+        self.fresh: Set[str] = set()
+        classes = analysis.la.graph.class_by_name
+        for node in ast.walk(fn.node):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                tail = dotted_name(node.value.func).rsplit(".", 1)[-1]
+                if tail in classes:
+                    self.fresh.add(node.targets[0].id)
+
+    # -- receiver resolution -----------------------------------------------
+    def _recv(self, node: ast.AST) -> Optional[Tuple[str, str]]:
+        """``<name>.<attr>`` → (class qname, attr) when the receiver's
+        class is known (self, or a ctor/attr-typed local)."""
+        if not (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)):
+            return None
+        base = node.value.id
+        if base in self.fresh:
+            return None             # unshared fresh object: cannot race
+        if self.fn.cls is not None and base == self.fn.self_name:
+            return self.fn.cls.qname, node.attr
+        t = self.local_types.get(base)
+        if t is not None:
+            return t, node.attr
+        return None
+
+    def _record(self, node: ast.AST, kind: str, *, const_store: bool = False,
+                container_op: bool = False) -> None:
+        rv = self._recv(node)
+        if rv is None:
+            return
+        cq, attr = rv
+        self.out.accesses.append(_Access(
+            cq, attr, node.lineno, kind, tuple(self.held),
+            const_store=const_store, container_op=container_op))
+
+    # -- lock context --------------------------------------------------------
+    def _ld(self, expr: ast.AST) -> Optional[lockgraph.LockDef]:
+        return self.an.la._lock_expr(self.fn, expr, self.local_types)
+
+    def visit_With(self, node: ast.With) -> None:
+        n = 0
+        for item in node.items:
+            ld = self._ld(item.context_expr)
+            if ld is None:
+                self.visit(item.context_expr)
+            else:
+                self.held.append(ld.fp)
+                n += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if n:
+            del self.held[len(self.held) - n:]
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node) -> None:
+        pass                        # nested defs get their own walk
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    # -- stores --------------------------------------------------------------
+    def _store_target(self, target: ast.AST, value: ast.AST) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._store_target(elt, value)
+            return
+        if isinstance(target, ast.Starred):
+            self._store_target(target.value, value)
+            return
+        if isinstance(target, ast.Subscript):
+            # self.X[...] = v mutates the container X in place
+            self._record(target.value, "write", container_op=True)
+            self.visit(target.slice)
+            return
+        const = isinstance(value, ast.Constant) \
+            and (value.value is None or isinstance(value.value, bool))
+        self._record(target, "write", const_store=const)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._store_target(t, node.value)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._store_target(node.target, node.value)
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if isinstance(node.target, ast.Subscript):
+            self._record(node.target.value, "read")
+            self._record(node.target.value, "write", container_op=True)
+            self.visit(node.target.slice)
+        else:
+            self._record(node.target, "read")
+            self._record(node.target, "write")
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                self._record(t.value, "write", container_op=True)
+
+    # -- loads / calls / escapes --------------------------------------------
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self._record(node, "read")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATOR_METHODS:
+            self._record(node.func.value, "write", container_op=True)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        # iterating a container while another thread mutates it is the
+        # dict-changed-size crash class — record as a container read
+        self._record(node.iter, "read", container_op=True)
+        self.generic_visit(node)
+
+    def _escape_value(self, value: Optional[ast.AST]) -> None:
+        rv = self._recv(value) if value is not None else None
+        if rv is not None:
+            self.out.escapes.append(_Escape(rv[0], rv[1], value.lineno))
+
+    def visit_Return(self, node: ast.Return) -> None:
+        self._escape_value(node.value)
+        if node.value is not None:
+            self.visit(node.value)
+
+    def visit_Yield(self, node: ast.Yield) -> None:
+        self._escape_value(node.value)
+        if node.value is not None:
+            self.visit(node.value)
+
+
+@dataclasses.dataclass
+class FieldInfo:
+    """Everything known about one (owner-class, attr) field."""
+    cq: str
+    attr: str
+    guard: FrozenSet[str] = frozenset()
+    annotated: bool = False
+    inconsistent: bool = False     # locked sites disagree on the lock
+    container: bool = False
+    thread_local: bool = False
+    writes: List[Tuple[str, "_Access", FrozenSet[str]]] = \
+        dataclasses.field(default_factory=list)   # (fn q, acc, full lockset)
+    reads: List[Tuple[str, "_Access", FrozenSet[str]]] = \
+        dataclasses.field(default_factory=list)
+
+    @property
+    def site(self) -> str:
+        return f"{self.cq}.{self.attr}"
+
+
+class RaceAnalysis:
+    """Whole-package PB9xx result on top of a shared LockAnalysis."""
+
+    def __init__(self, la: lockgraph.LockAnalysis):
+        self.la = la
+        self.graph = la.graph
+        self.fn_acc: Dict[str, _FnAccesses] = {}
+        self.entry: Dict[str, FrozenSet[str]] = {}
+        self.fields: Dict[Tuple[str, str], FieldInfo] = {}
+        self.findings: List[Finding] = []
+        self._annotations: Dict[Tuple[str, str], Set[str]] = {}
+        self._containers: Dict[str, Set[str]] = {}
+        self._locals_cls: Dict[str, Set[str]] = {}   # threading.local attrs
+        self._init_only: Dict[str, Set[str]] = {}
+        self._init_ctx_cache: Dict[str, bool] = {}
+        self._owner_key: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self._scan_classes()
+        for q, fn in self.graph.functions.items():
+            w = _AccessWalker(self, fn)
+            for stmt in fn.node.body:
+                w.visit(stmt)
+            self.fn_acc[q] = w.out
+        self._entry_fixpoint()
+        self._build_fields()
+        self._infer_guards()
+        self._pb901_sites: Set[Tuple[str, int, str]] = set()
+        self._check_pb901()
+        self._check_pb902()
+        self._check_pb903()
+        self._check_pb904()
+        self.findings.sort(key=lambda f: (f.path, f.line, f.code))
+
+    # ------------------------------------------------------ class scanning
+    def _scan_classes(self) -> None:
+        for cq, cls in self.graph.classes.items():
+            containers: Set[str] = set()
+            tlocals: Set[str] = set()
+            for fi in cls.methods.values():
+                self_name = fi.self_name or "self"
+                for node in ast.walk(fi.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        if not (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == self_name):
+                            continue
+                        tail = ""
+                        if isinstance(node.value, ast.Call):
+                            tail = dotted_name(
+                                node.value.func).rsplit(".", 1)[-1]
+                        if isinstance(node.value, (ast.List, ast.Dict,
+                                                   ast.Set, ast.ListComp,
+                                                   ast.DictComp,
+                                                   ast.SetComp)) \
+                                or tail in _CONTAINER_CTORS:
+                            containers.add(t.attr)
+                        if tail == "local" and isinstance(node.value,
+                                                          ast.Call) \
+                                and dotted_name(node.value.func) in (
+                                    "threading.local", "local"):
+                            tlocals.add(t.attr)
+            self._containers[cq] = containers
+            self._locals_cls[cq] = tlocals
+            self._init_only[cq] = self._init_only_methods(cls)
+            self._scan_annotations(cls)
+
+    @staticmethod
+    def _init_only_methods(cls: "callgraph.ClassInfo") -> Set[str]:
+        """__init__/__new__ plus private helpers called only from the
+        init set (pre-publication builders) — same rule as PB1xx."""
+        calls: Dict[str, Set[str]] = {}
+        for name, fi in cls.methods.items():
+            callees: Set[str] = set()
+            self_name = fi.self_name or "self"
+            for node in ast.walk(fi.node):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == self_name):
+                    callees.add(node.func.attr)
+            calls[name] = callees
+        out = {"__init__", "__new__"}
+        callers: Dict[str, Set[str]] = {n: set() for n in cls.methods}
+        for name, callees in calls.items():
+            for c in callees:
+                if c in callers:
+                    callers[c].add(name)
+        changed = True
+        while changed:
+            changed = False
+            for name, who in callers.items():
+                if (name not in out and name.startswith("_")
+                        and not name.startswith("__")
+                        and who and who <= out):
+                    out.add(name)
+                    changed = True
+        return out
+
+    def _scan_annotations(self, cls: "callgraph.ClassInfo") -> None:
+        """``# pboxlint: guarded-by=<fp>`` on a line that assigns (or
+        declares, class-body AnnAssign) ``self.<attr>`` / ``attr``."""
+        mod = cls.mod
+        annotated_lines: Dict[int, str] = {}
+        for lineno, text in enumerate(mod.source.splitlines(), 1):
+            m = _GUARDED_BY_RE.search(text)
+            if m:
+                annotated_lines[lineno] = m.group("fp")
+        if not annotated_lines:
+            return
+        for stmt in cls.node.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)) \
+                    and stmt.lineno in annotated_lines:
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        self._annotations.setdefault(
+                            (cls.qname, t.id), set()).add(
+                                annotated_lines[stmt.lineno])
+        for fi in cls.methods.values():
+            self_name = fi.self_name or "self"
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, (ast.Assign, ast.AnnAssign))
+                        and node.lineno in annotated_lines):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == self_name):
+                        self._annotations.setdefault(
+                            (cls.qname, t.attr), set()).add(
+                                annotated_lines[node.lineno])
+
+    # ------------------------------------------------------ entry fixpoint
+    def _prop_targets(self, cs: "callgraph.CallSite") -> Tuple[str, ...]:
+        """Call targets the caller's lockset flows into.  Spawn targets
+        run on a fresh thread — they contribute ∅ to the meet instead.
+        Widened calls propagate (the held-set is never dropped) unless
+        the CHA fan-out exceeds the cap."""
+        if cs.kind != "call":
+            return ()
+        if cs.widened and len(cs.targets) > _WIDEN_FANOUT_CAP:
+            return ()
+        return cs.targets
+
+    def _entry_fixpoint(self) -> None:
+        incoming: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
+        spawn_roots: Set[str] = set()
+        for q, s in self.la.summaries.items():
+            for cs in s.fn.calls:
+                held = s.call_held.get(id(cs.node), ())
+                if cs.kind == "spawn":
+                    spawn_roots.update(cs.targets)
+                    continue
+                if self._init_ctx(q):
+                    # pre-publication call: the constructing thread owns
+                    # the object exclusively, so the call site's (lack
+                    # of) locks says nothing about the steady state
+                    continue
+                for t in self._prop_targets(cs):
+                    incoming.setdefault(t, []).append((q, held))
+        # descending meet over call edges, ⊤ as a distinct sentinel (NOT
+        # the set of all locks — in a one-lock module a legitimate meet
+        # can equal that set and must survive)
+        top = object()
+        entry: Dict[str, object] = {}
+        for q in self.la.summaries:
+            if q in incoming and q not in spawn_roots:
+                entry[q] = top
+            else:
+                entry[q] = frozenset()
+        changed = True
+        while changed:
+            changed = False
+            for q, edges in incoming.items():
+                if q in spawn_roots:
+                    continue
+                met: object = top
+                for caller, held in edges:
+                    ce = entry.get(caller, frozenset())
+                    if ce is top:
+                        continue          # ⊤ caller: identity for the meet
+                    have = ce | frozenset(held)
+                    met = have if met is top else (met & have)
+                if met is not top and met != entry[q]:
+                    entry[q] = met
+                    changed = True
+        # a call cycle with no root caller never shrinks from ⊤ — treat
+        # its sites as lockset-unknown (∅) rather than held-everything
+        self.entry = {q: (frozenset() if e is top else e)
+                      for q, e in entry.items()}
+
+    # ------------------------------------------------------------ field db
+    def _owner(self, cq: str, attr: str,
+               touched: Set[Tuple[str, str]]) -> str:
+        """Topmost package ancestor that also touches ``attr`` — a
+        subclass writing an inherited field shares the base's identity."""
+        best = cq
+        stack = list(self.graph.classes.get(cq, _NO_CLS).bases)
+        seen = {cq}
+        while stack:
+            b = stack.pop()
+            if b in seen or b not in self.graph.classes:
+                continue
+            seen.add(b)
+            if (b, attr) in touched or attr in self._annotations_cls(b):
+                best = b
+            stack.extend(self.graph.classes[b].bases)
+        return best
+
+    def _annotations_cls(self, cq: str) -> Set[str]:
+        return {a for (c, a) in self._annotations if c == cq}
+
+    def _is_method(self, cq: str, attr: str) -> bool:
+        seen: Set[str] = set()
+        stack = [cq]
+        while stack:
+            q = stack.pop()
+            if q in seen or q not in self.graph.classes:
+                continue
+            seen.add(q)
+            if attr in self.graph.classes[q].methods:
+                return True
+            stack.extend(self.graph.classes[q].bases)
+        return False
+
+    def _is_lock_attr(self, cq: str, attr: str) -> bool:
+        return self.la._class_lock(cq, attr) is not None
+
+    def _init_ctx(self, q: str) -> bool:
+        """Does function ``q`` run pre-publication — an ``__init__``/
+        ``__new__``, a private helper reachable only from one, or a
+        closure nested inside either?"""
+        cached = self._init_ctx_cache.get(q)
+        if cached is not None:
+            return cached
+        out = False
+        tail = q.rsplit(".", 1)[-1]
+        if tail in ("__init__", "__new__"):
+            out = True
+        else:
+            for owner_cq, init_set in self._init_only.items():
+                for name in init_set:
+                    mq = f"{owner_cq}.{name}"
+                    if q == mq or q.startswith(mq + "."):
+                        out = True
+                        break
+                if out:
+                    break
+        self._init_ctx_cache[q] = out
+        return out
+
+    def _build_fields(self) -> None:
+        touched: Set[Tuple[str, str]] = set()
+        for out in self.fn_acc.values():
+            for acc in out.accesses:
+                touched.add((acc.cq, acc.attr))
+        for q, out in self.fn_acc.items():
+            ent = self.entry.get(q, frozenset())
+            for acc in out.accesses:
+                if self._is_lock_attr(acc.cq, acc.attr) \
+                        or self._is_method(acc.cq, acc.attr) \
+                        or acc.attr.startswith("__"):
+                    continue
+                owner = self._owner(acc.cq, acc.attr, touched)
+                key = (owner, acc.attr)
+                self._owner_key[(acc.cq, acc.attr)] = key
+                fi = self.fields.get(key)
+                if fi is None:
+                    fi = self.fields[key] = FieldInfo(owner, acc.attr)
+                    fi.container = acc.attr in self._containers.get(
+                        owner, ()) or acc.attr in self._containers.get(
+                            acc.cq, ())
+                    fi.thread_local = acc.attr in self._locals_cls.get(
+                        owner, ()) or acc.attr in self._locals_cls.get(
+                            acc.cq, ())
+                full = frozenset(acc.held) | ent
+                if acc.kind == "write":
+                    fi.writes.append((q, acc, full))
+                else:
+                    fi.reads.append((q, acc, full))
+
+    def _post_ctor_writes(self, fi: FieldInfo):
+        return [(q, acc, full) for q, acc, full in fi.writes
+                if not self._init_ctx(q)]
+
+    def _infer_guards(self) -> None:
+        for key, fi in self.fields.items():
+            ann = self._annotations.get(key)
+            if ann:
+                fi.guard = frozenset(ann)
+                fi.annotated = True
+                continue
+            if fi.thread_local:
+                continue
+            post = self._post_ctor_writes(fi)
+            locked = [full for _q, _a, full in post if full]
+            # the discipline must be the RULE, not the exception: a
+            # guard is inferred only when locked mutation sites are the
+            # strict majority — one incidental locked path (e.g. a
+            # wrapper serializing an otherwise main-thread object under
+            # ITS lock) does not define a discipline for the field
+            if not locked or len(locked) * 2 <= len(post):
+                continue
+            meet = frozenset.intersection(*locked)
+            if meet:
+                fi.guard = meet
+            else:
+                # locked sites disagree — pick the lock covering the
+                # most mutation sites as the candidate guard and call
+                # the discipline inconsistent
+                count: Dict[str, int] = {}
+                for full in locked:
+                    for fp in full:
+                        count[fp] = count.get(fp, 0) + 1
+                best = max(sorted(count), key=lambda fp: count[fp])
+                fi.guard = frozenset([best])
+                fi.inconsistent = True
+
+    # ------------------------------------------------------------ checkers
+    def _path_line(self, q: str, acc: _Access) -> Tuple[str, int]:
+        return self.la.summaries[q].fn.mod.path, acc.line
+
+    def _check_pb901(self) -> None:
+        for key in sorted(self.fields):
+            fi = self.fields[key]
+            if not fi.guard or fi.thread_local:
+                continue
+            guarded_at = next(
+                (self._path_line(q, a)
+                 for q, a, full in self._post_ctor_writes(fi)
+                 if fi.guard <= full), None)
+            for q, acc, full in self._post_ctor_writes(fi):
+                if fi.guard <= full:
+                    continue
+                if acc.const_store and not fi.annotated:
+                    continue        # atomic-flag publish
+                path, line = self._path_line(q, acc)
+                why = ("declared guarded-by " if fi.annotated else
+                       "inconsistently locked — candidate guard "
+                       if fi.inconsistent else "mutated under ")
+                wit = (f" (e.g. {guarded_at[0]}:{guarded_at[1]})"
+                       if guarded_at else "")
+                self._pb901_sites.add((path, line, fi.attr))
+                self.findings.append(Finding(
+                    path, line, "PB901",
+                    f"{fi.site} written here holding "
+                    f"{{{', '.join(sorted(full)) or 'nothing'}}} but "
+                    f"{why}{'+'.join(sorted(fi.guard))} elsewhere{wit} — "
+                    f"a concurrent writer tears the field; take the "
+                    f"guard or annotate/redesign the publication"))
+
+    def _invariant_groups(self) -> Dict[Tuple[str, str, str], str]:
+        """{(owner cq, attrA, attrB) → lock}: pairs of fields of one
+        class co-mutated inside one function while sharing a guard lock
+        that IS both fields' inferred guard."""
+        groups: Dict[Tuple[str, str, str], str] = {}
+        for q, out in self.fn_acc.items():
+            by_cls: Dict[str, List[_Access]] = {}
+            for acc in out.accesses:
+                if acc.kind == "write" and acc.held:
+                    by_cls.setdefault(acc.cq, []).append(acc)
+            for cq, accs in by_cls.items():
+                attrs = sorted({a.attr for a in accs})
+                for i, a1 in enumerate(attrs):
+                    for a2 in attrs[i + 1:]:
+                        f1 = self._field_of(cq, a1)
+                        f2 = self._field_of(cq, a2)
+                        if f1 is None or f2 is None:
+                            continue
+                        common = (f1.guard & f2.guard
+                                  & frozenset(h for a in accs if a.attr == a1
+                                              for h in a.held)
+                                  & frozenset(h for a in accs if a.attr == a2
+                                              for h in a.held))
+                        if common and not (f1.inconsistent
+                                           or f2.inconsistent):
+                            groups[(f1.cq, min(a1, a2), max(a1, a2))] = \
+                                sorted(common)[0]
+        return groups
+
+    def _field_of(self, cq: str, attr: str) -> Optional[FieldInfo]:
+        key = self._owner_key.get((cq, attr))
+        return self.fields.get(key) if key is not None else None
+
+    def _check_pb902(self) -> None:
+        groups = self._invariant_groups()
+        reported: Set[Tuple[str, int]] = set()
+        for (cq, a1, a2), lock in sorted(groups.items()):
+            for q, out in sorted(self.fn_acc.items()):
+                if self._init_ctx(q):
+                    continue
+                ent = self.entry.get(q, frozenset())
+                bare: Dict[str, _Access] = {}
+                for acc in out.accesses:
+                    fi = self._field_of(acc.cq, acc.attr)
+                    if fi is None or fi.cq != cq \
+                            or acc.attr not in (a1, a2):
+                        continue
+                    full = frozenset(acc.held) | ent
+                    if acc.kind == "read" and lock not in full:
+                        bare.setdefault(acc.attr, acc)
+                    elif lock in full:
+                        bare.clear()    # this fn does lock; mixed —
+                        break           # trust the locked region
+                if len(bare) == 2:
+                    acc = max(bare.values(), key=lambda a: a.line)
+                    path, line = self._path_line(q, acc)
+                    if (path, line) in reported:
+                        continue
+                    reported.add((path, line))
+                    self.findings.append(Finding(
+                        path, line, "PB902",
+                        f"{cq}.{a1}/{a2} form a multi-word invariant "
+                        f"(co-mutated under {lock}) but are read here "
+                        f"with it not held — a concurrent mutation is "
+                        f"observable mid-update; read both under the "
+                        f"lock or snapshot them together"))
+
+    def _check_pb903(self) -> None:
+        for q, out in sorted(self.fn_acc.items()):
+            for esc in out.escapes:
+                fi = self._field_of(esc.cq, esc.attr)
+                if fi is None or not fi.guard or not fi.container \
+                        or fi.thread_local:
+                    continue
+                if self._init_ctx(q):
+                    continue
+                path = self.la.summaries[q].fn.mod.path
+                self.findings.append(Finding(
+                    path, esc.line, "PB903",
+                    f"{fi.site} is a container guarded by "
+                    f"{'+'.join(sorted(fi.guard))} but its bare "
+                    f"reference escapes here — the caller aliases live "
+                    f"mutable state outside the critical section; "
+                    f"return a copy (list()/dict()/.copy()) or a "
+                    f"frozen view"))
+
+    def _check_pb904(self) -> None:
+        spawn_sites: List[Tuple[str, "callgraph.CallSite"]] = []
+        for q, s in self.la.summaries.items():
+            for cs in s.fn.calls:
+                if cs.kind == "spawn":
+                    spawn_sites.append((q, cs))
+        reported: Set[Tuple[str, int, str]] = set()
+        for q, cs in sorted(spawn_sites, key=lambda t: (t[0], t[1].line)):
+            for t in cs.targets:
+                self._walk_spawn(t, frozenset(), set(), reported)
+
+    def _walk_spawn(self, q: str, held: FrozenSet[str],
+                    seen: Set[Tuple[str, FrozenSet[str]]],
+                    reported: Set[Tuple[str, int, str]]) -> None:
+        key = (q, held)
+        if key in seen or q not in self.fn_acc:
+            return
+        seen.add(key)
+        out = self.fn_acc[q]
+        # constructing a fresh object ON the spawned thread is still
+        # pre-publication — skip init-context accesses, walk their calls
+        accesses = () if self._init_ctx(q) else out.accesses
+        for acc in accesses:
+            fi = self._field_of(acc.cq, acc.attr)
+            if fi is None or not fi.guard or fi.thread_local \
+                    or fi.inconsistent:
+                continue
+            full = held | frozenset(acc.held)
+            if fi.guard & full:
+                continue
+            # single-word bare reads are GIL-atomic snapshots; what
+            # races on a spawn path is a write or container traffic
+            if acc.kind != "write" and not acc.container_op:
+                continue
+            if acc.const_store and not fi.annotated:
+                continue
+            path, line = self._path_line(q, acc)
+            if (path, line, acc.attr) in reported \
+                    or (path, line, acc.attr) in self._pb901_sites:
+                continue
+            reported.add((path, line, acc.attr))
+            self.findings.append(Finding(
+                path, line, "PB904",
+                f"thread-spawned path reaches this "
+                f"{'write to' if acc.kind == 'write' else 'traversal of'}"
+                f" {fi.site} with no lock held (guard "
+                f"{'+'.join(sorted(fi.guard))}) — the spawner's locks "
+                f"never flow into a new thread; take the guard inside "
+                f"the task"))
+        s = self.la.summaries.get(q)
+        if s is None:
+            return
+        for cs in s.fn.calls:
+            site_held = held | frozenset(
+                s.call_held.get(id(cs.node), ()))
+            for t in self._prop_targets(cs):
+                self._walk_spawn(t, site_held, seen, reported)
+
+    # ------------------------------------------------------------- exports
+    def guard_map(self) -> Dict[str, List[str]]:
+        """{field site → sorted guard fingerprints} — the static half of
+        the lockdep.guards() runtime containment contract."""
+        return {fi.site: sorted(fi.guard)
+                for fi in self.fields.values()
+                if fi.guard and not fi.inconsistent}
+
+
+class _NoCls:
+    bases: List[str] = []
+
+
+_NO_CLS = _NoCls()
+
+
+def analyze(modules: Sequence[Module]) -> RaceAnalysis:
+    return RaceAnalysis(lockgraph.analyze(modules))
+
+
+def analyze_paths(paths: Sequence[str]) -> RaceAnalysis:
+    """Convenience for tests & the runtime cross-validation soak."""
+    from paddlebox_tpu.tools.pboxlint.core import iter_py_files
+    mods = []
+    for p in iter_py_files(paths):
+        with open(p, encoding="utf-8") as f:
+            mods.append(Module(p, f.read()))
+    return analyze(mods)
+
+
+def guard_map_paths(paths: Sequence[str]) -> Dict[str, List[str]]:
+    return analyze_paths(paths).guard_map()
+
+
+def check(mod: Module, ctx: PackageContext) -> List[Finding]:
+    la = getattr(ctx, "_lockgraph", None)
+    if la is None:
+        la = lockgraph.analyze(ctx.modules)
+        ctx._lockgraph = la             # shared with lockgraph.check
+    cache = getattr(ctx, "_raceguard", None)
+    if cache is None:
+        cache = RaceAnalysis(la)
+        ctx._raceguard = cache
+    return [f for f in cache.findings if f.path == mod.path]

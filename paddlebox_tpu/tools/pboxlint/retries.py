@@ -36,7 +36,7 @@ def _is_const_sleep(node: ast.AST) -> bool:
 def check(mod: Module, ctx: PackageContext) -> List[Finding]:
     findings: List[Finding] = []
     flagged: Set[int] = set()       # nested loops: report each sleep once
-    for loop in ast.walk(mod.tree):
+    for loop in mod.walk():
         if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
             continue
         if not any(isinstance(n, ast.Try) and n.handlers

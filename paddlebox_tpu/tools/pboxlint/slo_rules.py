@@ -51,7 +51,7 @@ def _collect_emitted(ctx: PackageContext
     patterns: List[str] = []
     dynamic = False
     for mod in ctx.modules:
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if not (isinstance(node, ast.Call) and node.args):
                 continue
             tail = dotted_name(node.func).rsplit(".", 1)[-1]
@@ -86,7 +86,7 @@ def _rule_sinks(mod: Module) -> Set[str]:
     timeline.SloRule — plus the bare name inside timeline.py itself
     (where default_rules constructs them)."""
     sinks: Set[str] = set()
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.Import):
             for alias in node.names:
                 if alias.name == _TIMELINE_MOD:
@@ -123,7 +123,7 @@ def check(mod: Module, ctx: PackageContext) -> List[Finding]:
     if dynamic:
         return []
     findings: List[Finding] = []
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         if dotted_name(node.func) not in sinks:

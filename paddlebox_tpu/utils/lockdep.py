@@ -45,6 +45,17 @@ flags.define_flag(
     "online cycle detection; lock_cycle flight events + doctor state). "
     "Debug/soak mode: off = raw threading primitives, zero cost")
 
+flags.define_flag(
+    "lockdep_guards", False,
+    "with FLAGS_lockdep: activate the guarded-by witness — "
+    "lockdep.guards(obj, field) assertion points at hot mutation sites "
+    "(plus install_guard_probe sampling proxies) record (site, "
+    "held-locks) observations and, against an installed static "
+    "guarded-by map (pboxlint raceguard.guard_map()), emit ONE "
+    "race_suspect flight event per violating site for doctor "
+    "postmortems. Off (the default): guards() is a single cached-flag "
+    "test, zero allocation")
+
 # -- global witness state (plain primitives: never instrumented) ----------
 _graph_lock = threading.Lock()
 _edges: Dict[Tuple[str, str], Dict] = {}        # (a, b) → first witness
@@ -53,9 +64,30 @@ _seen_cycles: Set[Tuple[str, ...]] = set()
 _held_tls = threading.local()                   # .names: List[str]
 _held_by_thread: Dict[int, List[str]] = {}      # ident → alias of the list
 
+# -- guarded-by witness state (PB9xx runtime half) ------------------------
+_guards_cache: Optional[bool] = None            # lazy flag resolve
+_guard_map: Dict[str, Tuple[str, ...]] = {}     # site → static guard fps
+_guard_obs: Dict[str, Set[Tuple[str, ...]]] = {}  # site → held-set tuples
+_guard_suspects: List[Dict] = []
+_suspect_sites: Set[str] = set()
+
 
 def enabled() -> bool:
     return bool(flags.get_flags("lockdep"))
+
+
+def guards_enabled() -> bool:
+    """Both flags on — the guards witness needs FLAGS_lockdep for its
+    held-sets (raw primitives record nothing).  Resolved once and
+    cached so the off-path in ``guards()`` is one global load;
+    ``reset()`` clears the cache (the test fixture pattern: set flags,
+    then ``lockdep.reset()``)."""
+    global _guards_cache
+    on = _guards_cache
+    if on is None:
+        on = _guards_cache = bool(
+            flags.get_flags("lockdep_guards")) and enabled()
+    return on
 
 
 def _held() -> List[str]:
@@ -193,6 +225,93 @@ def condition(name: str, lock: Optional[LockLike] = None) \
     return threading.Condition(lock if lock is not None else rlock(name))
 
 
+# -- guarded-by witness (the dynamic half of pboxlint PB9xx) --------------
+def _site_of(obj, field: str) -> str:
+    """Runtime site name in the STATIC analyzer's namespace:
+    ``ps.service.PSServer._staged`` — ``type(obj).__module__`` with the
+    package prefix stripped + qualname + field, exactly the
+    ``FieldInfo.site`` key raceguard.guard_map() exports."""
+    cls = type(obj)
+    mod = cls.__module__
+    if mod.startswith("paddlebox_tpu."):
+        mod = mod[len("paddlebox_tpu."):]
+    return f"{mod}.{cls.__qualname__}.{field}"
+
+
+def guards(obj, field: str) -> None:
+    """Assertion point at a hot mutation site: records the (site,
+    held-locks) observation and — when a static guarded-by map is
+    installed and names this site — emits a ``race_suspect`` flight
+    event (once per site) if none of the site's guards is held.
+    Advisory like the cycle witness: never raises."""
+    if not guards_enabled():
+        return
+    site = _site_of(obj, field)
+    held = tuple(_held())
+    suspect = None
+    with _graph_lock:
+        _guard_obs.setdefault(site, set()).add(held)
+        want = _guard_map.get(site)
+        if want is not None and site not in _suspect_sites \
+                and not set(held).intersection(want):
+            _suspect_sites.add(site)
+            suspect = {"site": site, "held": list(held),
+                       "guard": list(want),
+                       "thread": threading.current_thread().name}
+            _guard_suspects.append(suspect)
+    if suspect is not None:                     # flight: outside the lock
+        flight.record("race_suspect", site=site,
+                      held=",".join(suspect["held"]) or "(none)",
+                      guard=",".join(suspect["guard"]),
+                      thread=suspect["thread"])
+
+
+def set_guard_map(mapping: Dict[str, List[str]]) -> None:
+    """Install the static guarded-by map (raceguard.guard_map() shape:
+    {site: [guard fingerprints]}) that ``guards()`` checks against."""
+    with _graph_lock:
+        _guard_map.clear()
+        for site, fps in mapping.items():
+            _guard_map[site] = tuple(fps)
+
+
+def guard_observations() -> Dict[str, List[List[str]]]:
+    """{site: sorted list of observed held-set lists} — the runtime half
+    tier-1 asserts ⊆ the static guarded-by map."""
+    with _graph_lock:
+        return {site: sorted(list(h) for h in obs)
+                for site, obs in sorted(_guard_obs.items())}
+
+
+def guard_suspects() -> List[Dict]:
+    with _graph_lock:
+        return [dict(s) for s in _guard_suspects]
+
+
+def install_guard_probe(cls: type, fields: List[str], every: int = 1):
+    """Sampling proxy for annotated classes with no inline assertion
+    points: wraps ``cls.__setattr__`` so every ``every``-th store to one
+    of ``fields`` runs ``guards()`` first (the held-set at store time is
+    what matters).  Returns a restore callable.  The sample counter is
+    deliberately unlocked — it only paces sampling."""
+    watched = frozenset(fields)
+    orig = cls.__setattr__
+    state = {"n": 0}
+
+    def probing(self, name, value):
+        if name in watched:
+            state["n"] += 1
+            if state["n"] % max(1, every) == 0:
+                guards(self, name)
+        orig(self, name, value)
+
+    cls.__setattr__ = probing
+
+    def restore():
+        cls.__setattr__ = orig
+    return restore
+
+
 # -- introspection (doctor / tests / cross-validation) --------------------
 def edges() -> List[Tuple[str, str]]:
     with _graph_lock:
@@ -220,14 +339,25 @@ def state() -> Dict:
         edge_list = [{"from": a, "to": b, **info}
                      for (a, b), info in sorted(_edges.items())]
         cyc = [dict(c) for c in _cycles]
+        guard = {"enabled": guards_enabled(),
+                 "sites_observed": len(_guard_obs),
+                 "map_installed": len(_guard_map),
+                 "suspects": [dict(s) for s in _guard_suspects]}
     return {"enabled": enabled(), "edges": edge_list, "cycles": cyc,
-            "held": held_by_thread()}
+            "held": held_by_thread(), "guards": guard}
 
 
 def reset() -> None:
-    """Test helper: drop all recorded edges/cycles (held-sets persist —
+    """Test helper: drop all recorded edges/cycles and guard
+    observations, and re-resolve the guards flag (held-sets persist —
     they mirror locks actually held right now)."""
+    global _guards_cache
     with _graph_lock:
         _edges.clear()
         _cycles.clear()
         _seen_cycles.clear()
+        _guard_obs.clear()
+        _guard_suspects.clear()
+        _suspect_sites.clear()
+        _guard_map.clear()
+        _guards_cache = None

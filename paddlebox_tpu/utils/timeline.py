@@ -43,7 +43,7 @@ from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from paddlebox_tpu import flags
-from paddlebox_tpu.utils import flight
+from paddlebox_tpu.utils import flight, lockdep
 from paddlebox_tpu.utils.monitor import StatRegistry, stat_add, stat_set
 
 flags.define_flag(
@@ -94,7 +94,7 @@ class TimelineRing:
 
     def __init__(self, cap: int):
         self._ring: "deque[Dict]" = deque(maxlen=max(2, int(cap)))
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("utils.timeline.TimelineRing._lock")
         self._prev: Optional[Tuple[float, Dict[str, float]]] = None
         self._seq = 0
 
@@ -123,6 +123,7 @@ class TimelineRing:
                             # so the interval's growth is the new value
                             d = v
                         rates[k] = d / dt
+            lockdep.guards(self, "_seq")
             self._seq += 1
             sample = {"seq": self._seq, "t": t, "mono": mono,
                       "stats": dict(stats), "rates": rates}
@@ -254,7 +255,7 @@ class SloWatchdog:
     def __init__(self, rules: Sequence[SloRule]):
         self.rules = list(rules)
         self._breached: Dict[str, bool] = {r.name: False for r in self.rules}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("utils.timeline.SloWatchdog._lock")
 
     def evaluate(self, ring: TimelineRing,
                  now_mono: Optional[float] = None) -> List[Dict]:

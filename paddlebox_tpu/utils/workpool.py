@@ -90,6 +90,7 @@ class WorkPool:
 
     def _run_one(self, fn: Callable[[T], R], item: T) -> R:
         with self._lock:
+            lockdep.guards(self, "_active")
             self._queued -= 1
             self._active += 1
             active = self._active

@@ -1038,6 +1038,135 @@ def test_cli_json_and_baseline_diff(tmp_path):
     assert "PB401" in proc.stdout
 
 
+def test_pb901_wired_into_default_checker_set():
+    """PB9xx rides the same gate as every other family: plain
+    lint_source over a racy-counter snippet must surface PB901."""
+    src = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def hit(self):
+            with self._lock:
+                self._n += 1
+
+        def hit2(self):
+            with self._lock:
+                self._n += 1
+
+        def racy(self):
+            self._n += 1
+    """
+    assert "PB901" in codes(src)
+
+
+_RACY_SNIPPET = """
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def hit(self):
+        with self._lock:
+            self._n += 1
+
+    def hit2(self):
+        with self._lock:
+            self._n += 1
+
+    def racy(self):
+        self._n += 1
+"""
+
+
+def test_cli_select_filters_families(tmp_path):
+    """--select=PB9xx keeps only the race family (exit 1 when it fires,
+    0 when the selected family is clean) and composes with
+    --format=json: counts contain only selected buckets."""
+    snip = tmp_path / "racy.py"
+    snip.write_text(_RACY_SNIPPET)
+    cmd = [sys.executable, "-m", "paddlebox_tpu.tools.pboxlint"]
+
+    proc = subprocess.run(
+        cmd + ["--select=PB9xx", "--format=json", str(snip)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert {f["code"] for f in out["findings"]} == {"PB901"}
+    assert all(":PB9" in k for k in out["counts"])
+
+    # the same tree through a family with nothing to say: exit 0
+    proc = subprocess.run(
+        cmd + ["--select=PB6xx", str(snip)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # exact-code token: PB901 alone also selects the finding
+    proc = subprocess.run(
+        cmd + ["--select", "PB901", str(snip)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "PB901" in proc.stdout
+
+    # an empty selector is an operator error, not "select nothing"
+    proc = subprocess.run(
+        cmd + ["--select=", str(snip)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 2
+
+
+def test_cli_select_composes_with_baseline(tmp_path):
+    """A baseline written under --select only carries selected buckets,
+    and re-linting with the same selection diffs clean."""
+    snip = tmp_path / "racy.py"
+    snip.write_text(_RACY_SNIPPET)
+    base = tmp_path / "base.json"
+    cmd = [sys.executable, "-m", "paddlebox_tpu.tools.pboxlint",
+           "--select=PB9xx"]
+    proc = subprocess.run(
+        cmd + ["--write-baseline", str(base), str(snip)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    counts = json.loads(base.read_text())["counts"]
+    assert counts and all(":PB9" in k for k in counts)
+    proc = subprocess.run(
+        cmd + ["--baseline", str(base), str(snip)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_stats_reports_per_checker_timing(tmp_path):
+    """--stats attaches per-checker wall seconds: a 'stats' object in
+    JSON mode (checker-module keys, numeric values) and a stderr table
+    in text mode — stdout findings stay machine-parseable."""
+    snip = tmp_path / "racy.py"
+    snip.write_text(_RACY_SNIPPET)
+    cmd = [sys.executable, "-m", "paddlebox_tpu.tools.pboxlint"]
+
+    proc = subprocess.run(
+        cmd + ["--stats", "--format=json", str(snip)],
+        capture_output=True, text=True, cwd=REPO)
+    out = json.loads(proc.stdout)
+    assert "stats" in out
+    for name in ("raceguard", "lockgraph", "locks"):
+        assert name in out["stats"], sorted(out["stats"])
+    assert all(isinstance(v, (int, float)) and v >= 0
+               for v in out["stats"].values())
+
+    proc = subprocess.run(
+        cmd + ["--stats", str(snip)],
+        capture_output=True, text=True, cwd=REPO)
+    assert "raceguard" in proc.stderr
+    assert "TOTAL" in proc.stderr
+    assert "raceguard" not in proc.stdout.replace("PB9", "")
+
+
 def test_launcher_exports_and_readme_flags_are_registered():
     """S2 cross-check: every FLAGS_<name> env export in launch.py and
     every README flag-table row must name a flag actually registered via
