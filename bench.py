@@ -59,6 +59,9 @@ BENCH_SERVING=0 (skip the serving-tier QPS/p99 phase),
 BENCH_SERVING_KEYS/_BATCHES/_BATCH (serving-phase geometry),
 BENCH_CLUSTER=0 (skip the sharded-PS N=1 vs N=4 phase),
 BENCH_CLUSTER_KEYS/_ROUNDS/_BATCH/_SHARDS/_REPS (cluster-phase geometry),
+BENCH_MT=0 (skip the trainer-fleet N=1 vs N=4 phase),
+BENCH_MT_FILES/_ROWS/_TRAINERS/_SHARDS (multi-trainer geometry),
+BENCH_MT_CHAOS=0 (skip the multi-trainer kill/restart MTTR rep),
 BENCH_TIMELINE_S (telemetry-timeline sampler cadence, default 1.0;
 0 disables — the run's `timeline` summary then stays empty).
 """
@@ -1005,6 +1008,181 @@ def _reshard_bench(tag):
         reap(old_procs + new_procs)
 
 
+def _multi_trainer_bench(tag):
+    """Trainer-fleet phase: N=1 vs N=4 REAL subprocess trainers (one OS
+    process per rank — trainer/fleet_main.py — against an M=2 subprocess
+    PS cluster) over IDENTICAL zipf-keyed day files, the ISSUE-17
+    data-parallel scale-out claim.
+
+    Scaling is defined on the CRITICAL-PATH basis, same discipline as
+    _cluster_bench: on a host with fewer cores than ranks, concurrent
+    wall clock measures core timesharing, not fleet capacity.  Each rank
+    reports its own process CPU seconds for the measured lap (fleet_main
+    --warm runs the schedule once un-timed first, so jit compile and PS
+    row creation are excluded), a blocked rank burns no CPU, and the
+    fleet finishes when its busiest rank does:
+
+        scaling = cpu_s(N=1) / max_rank(cpu_s(N=4))
+
+    The chaos rep re-runs at N=2 with a seeded mid-allreduce kill of
+    rank 1; its supervisor restart lands restart_mttr_s (observed death
+    to the replacement incarnation entering run())."""
+
+    import subprocess
+    import tempfile
+
+    n_files = int(os.environ.get("BENCH_MT_FILES", 8))
+    rows = int(os.environ.get("BENCH_MT_ROWS", 1500))
+    n_wide = int(os.environ.get("BENCH_MT_TRAINERS", 4))
+    m_shards = int(os.environ.get("BENCH_MT_SHARDS", 2))
+    chaos = os.environ.get("BENCH_MT_CHAOS", "1") == "1"
+    mf_dim, n_slots, dense_dim, vocab = 4, 3, 2, 600
+    zipf_a = 1.3
+
+    tmp = tempfile.mkdtemp(prefix="bench-mt-")
+    rng = np.random.default_rng(29)
+    files = []
+    for i in range(n_files):
+        path = os.path.join(tmp, f"day0-f{i}.txt")
+        with open(path, "w") as f:
+            for _ in range(rows):
+                parts = [
+                    f"1 {int(rng.random() < 0.5)}",
+                    "2 " + " ".join(f"{d:.4f}"
+                                    for d in rng.normal(0, 1, dense_dim))]
+                for s in range(n_slots):
+                    kk = np.minimum(
+                        rng.zipf(zipf_a, size=int(rng.integers(1, 3))),
+                        vocab)
+                    parts.append(f"{len(kk)} " + " ".join(
+                        str(s * 1000 + int(k)) for k in kk))
+                f.write(" ".join(parts) + "\n")
+        files.append(path)
+    days = [["20260701", [files[:n_files // 2], files[n_files // 2:]]]]
+    examples = n_files * rows            # each file trained once per lap
+    spec_path = os.path.join(tmp, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump({"days": days, "n_slots": n_slots, "mf_dim": mf_dim,
+                   "dense_dim": dense_dim}, f)
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    def spawn_ps(n):
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "paddlebox_tpu.ps.server_main",
+             "--port", "0", "--mf_dim", str(mf_dim), "--seed", "5"],
+            cwd=repo, env=env, stdout=subprocess.PIPE, text=True)
+            for _ in range(n)]
+        addrs = []
+        for p in procs:
+            line = p.stdout.readline().strip()
+            host, _, port = line.rpartition(" ")[2].rpartition(":")
+            addrs.append((host, int(port)))
+        return procs, addrs
+
+    def reap(procs):
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    # fixed trainer ports BELOW the ephemeral range: a supervisor-
+    # restarted rank re-binds its OWN address, which must not be
+    # squattable as some outbound connection's local port
+    port_base = [27100]
+
+    def free_ports(n):
+        import socket as _socket
+        out = []
+        while len(out) < n:
+            port_base[0] += 1
+            try:
+                s = _socket.socket()
+                s.bind(("127.0.0.1", port_base[0]))
+                s.close()
+                out.append(port_base[0])
+            except OSError:
+                pass
+        return out
+
+    def run_fleet(world, label, fault_site=None, fault_rank=None):
+        set_phase(f"{tag}:multi_trainer[{label}]", 900)
+        ps_procs, ps_addrs = spawn_ps(m_shards)
+        try:
+            ps_csv = ",".join(f"{h}:{p}" for h, p in ps_addrs)
+            tr_csv = ",".join(f"127.0.0.1:{p}" for p in free_ports(world))
+            procs = []
+            for r in range(world):
+                cmd = [sys.executable, "-m",
+                       "paddlebox_tpu.trainer.fleet_main",
+                       "--rank", str(r), "--world", str(world),
+                       "--ps", ps_csv,
+                       "--workdir", os.path.join(tmp, f"wd-{label}"),
+                       "--spec", spec_path, "--virtual_shards", "4",
+                       "--table_seed", "5", "--warm"]
+                if world > 1:
+                    cmd += ["--trainer_addrs", tr_csv]
+                if fault_site is not None and r == fault_rank:
+                    cmd += ["--fault_site", fault_site]
+                procs.append(subprocess.Popen(
+                    cmd, cwd=repo, env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL, text=True))
+            reports = {}
+            for r, p in enumerate(procs):
+                out, _ = p.communicate(timeout=900)
+                lines = [ln for ln in out.splitlines()
+                         if ln.startswith("FLEETMAIN ")]
+                if p.returncode != 0 or not lines:
+                    raise RuntimeError(
+                        f"trainer rank {r} ({label}) failed "
+                        f"(rc={p.returncode})")
+                reports[r] = json.loads(lines[-1][len("FLEETMAIN "):])
+            return reports
+        finally:
+            reap(ps_procs)
+
+    def delta(rep, key):
+        return (float(rep["stats"].get(key, 0.0))
+                - float(rep["stats_warm"].get(key, 0.0)))
+
+    one = run_fleet(1, "n=1")
+    wide = run_fleet(n_wide, f"n={n_wide}")
+
+    busy1 = float(one[0]["cpu_s"])
+    critical = max(float(r["cpu_s"]) for r in wide.values())
+    tx = sum(delta(r, "trainer.fleet.shuffle_tx_bytes")
+             for r in wide.values())
+    shuffle_s = max(delta(r, "trainer.fleet.shuffle_s.sum")
+                    for r in wide.values())
+    p99 = max(float(r["stats"].get("trainer.fleet.barrier_wait_s.p99",
+                                   0.0)) for r in wide.values())
+    out = {"n1": {"cpu_s": round(busy1, 3),
+                  "wall_s": one[0]["wall_s"],
+                  "ex_s": round(examples / max(busy1, 1e-9))},
+           "n4": {"critical_cpu_s": round(critical, 3),
+                  "wall_s": max(r["wall_s"] for r in wide.values()),
+                  "ex_s": round(examples / max(critical, 1e-9))},
+           "n_trainers": n_wide, "ps_shards": m_shards,
+           "examples": int(examples), "zipf_a": zipf_a,
+           "scaling": round(busy1 / max(critical, 1e-9), 2),
+           "shuffle_mb_s": round(tx / 1e6 / max(shuffle_s, 1e-9), 2),
+           "barrier_wait_p99": round(p99, 4)}
+    if chaos:
+        ch = run_fleet(2, "chaos", fault_site="fleet_allreduce",
+                       fault_rank=1)
+        out["restart_mttr_s"] = round(float(
+            ch[1]["stats"].get("trainer.fleet.restart_mttr_s.max", 0.0)),
+            3)
+        out["chaos_restarts"] = int(ch[1]["restarts"])
+    return out
+
+
 def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     """One full bench at a given geometry.  Returns the results dict;
     records partials into _STATE as they are measured."""
@@ -1292,10 +1470,32 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
         except Exception as e:  # phase is diagnostic, never fatal
             trace(f"{tag}: reshard bench failed: {type(e).__name__}: {e}")
 
+    multi_trainer = {}
+    if tag == "full" and not legacy \
+            and os.environ.get("BENCH_MT", "1") == "1":
+        set_phase(f"{tag}:multi_trainer", 900)
+        try:
+            multi_trainer = _multi_trainer_bench(tag)
+            record(mt_scaling=multi_trainer["scaling"],
+                   mt_ex_s=multi_trainer["n4"]["ex_s"])
+            trace(f"{tag}: multi_trainer n1={multi_trainer['n1']['ex_s']:,}"
+                  f" ex/s n{multi_trainer['n_trainers']}="
+                  f"{multi_trainer['n4']['ex_s']:,} ex/s (critical-path "
+                  f"cpu basis) scaling={multi_trainer['scaling']:.2f}x "
+                  f"shuffle={multi_trainer['shuffle_mb_s']:.1f}MB/s "
+                  f"barrier_p99={multi_trainer['barrier_wait_p99']:.3f}s "
+                  f"mttr={multi_trainer.get('restart_mttr_s', 0.0):.2f}s")
+            if multi_trainer["scaling"] < 2.0:
+                trace(f"{tag}: WARNING multi_trainer scaling below the "
+                      "2x acceptance floor at N=4")
+        except Exception as e:  # phase is diagnostic, never fatal
+            trace(f"{tag}: multi_trainer bench failed: "
+                  f"{type(e).__name__}: {e}")
+
     return {"e2e": e2e_eps, "device_step": device_eps,
             "pass_cycle": pass_cycle, "recovery": recovery,
             "cache": cache_cmp, "serving": serving, "cluster": cluster,
-            "reshard": reshard,
+            "reshard": reshard, "multi_trainer": multi_trainer,
             "batches": int(stats["batches"]), "examples": int(n_examples),
             "auc": round(float(stats.get("auc", float("nan"))), 4),
             "compile_s": round(compile_s, 1), "pass_pack_s": round(pack_s, 1),
@@ -1386,6 +1586,7 @@ def run() -> None:
          pass_cycle=full["pass_cycle"], recovery=full["recovery"],
          cache=full["cache"], serving=full["serving"],
          cluster=full["cluster"], reshard=full["reshard"],
+         multi_trainer=full["multi_trainer"],
          feed_intervals=full["feed_intervals"], timers=full["timers"],
          timeline=_timeline_summary(), obs_stats=_obs_snapshot())
 
@@ -1798,6 +1999,27 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
         if rdo is not None and rdn > rdo + 0.10:
             regressions.append(
                 f"reshard.nonmoving_qps_drop {rdo:.3f} -> {rdn:.3f}")
+    mto, mtn = old.get("multi_trainer") or {}, \
+        new.get("multi_trainer") or {}
+    sco, scn = num(mto, "scaling"), num(mtn, "scaling")
+    if sco and scn is not None:         # worse fleet scaling = regression
+        scfrac = (scn - sco) / sco
+        out["multi_trainer_scaling"] = {"old": sco, "new": scn,
+                                        "delta_frac": round(scfrac, 4)}
+        if scfrac < -threshold:
+            regressions.append(
+                f"multi_trainer.scaling {sco:.2f}x -> {scn:.2f}x "
+                f"({scfrac:+.1%})")
+    tmo = num(mto, "restart_mttr_s")
+    tmn = num(mtn, "restart_mttr_s")
+    if tmn is not None:                 # slower trainer restart = regression
+        # one kill -> one restart interval per run, backoff-quantised, so
+        # gate only on half-again growth; a None baseline means the old
+        # record predates the phase, NOT a zero-MTTR measurement
+        out["multi_trainer_restart_mttr_s"] = {"old": tmo, "new": tmn}
+        if tmo and (tmn - tmo) / tmo > max(threshold, 0.5):
+            regressions.append(
+                f"multi_trainer.restart_mttr_s {tmo:.2f} -> {tmn:.2f}")
     mo = num(old.get("recovery") or {}, "mttr_s")
     mn = num(new.get("recovery") or {}, "mttr_s")
     if mo and mn is not None:           # slower recovery = regression

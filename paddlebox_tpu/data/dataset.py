@@ -50,6 +50,17 @@ class ShuffleTransport:
     def barrier(self) -> None:
         pass
 
+    def set_epoch(self, epoch: int) -> None:
+        """Enter a shuffle epoch (fleet fault tolerance; see
+        data/shuffle_transport.py).  No-op for epoch-less transports."""
+
+    def resync(self) -> None:
+        """Ask peers to replay the current epoch (restart recovery).
+        No-op for transports without a resend buffer."""
+
+    def close(self) -> None:
+        pass
+
 
 class LoopbackTransport(ShuffleTransport):
     """Single-process world; optionally emulates N ranks for tests."""

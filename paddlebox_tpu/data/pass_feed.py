@@ -100,6 +100,29 @@ def _record_ranges(n: int, threads: int) -> List[tuple]:
             for i in range(len(bounds) - 1) if bounds[i + 1] > bounds[i]]
 
 
+def route_keys(block: SlotRecordBlock) -> np.ndarray:
+    """Per-record shuffle route key for the fleet's global shuffle-by-key
+    (≙ the reference's shuffle_by_uid / global_shuffle key extraction):
+    the FIRST feasign of the record's first non-empty uint64 slot, slots
+    visited in sorted-name order.  Both orders are properties of the data
+    alone — independent of reader thread, file split, or fleet size — so
+    every fleet width routes a given record identically.  Records with no
+    sparse key at all route as key 0 (all land on one slice; degenerate
+    but still deterministic)."""
+    keys = np.zeros(block.n, dtype=np.uint64)
+    found = np.zeros(block.n, dtype=bool)
+    for name in sorted(block.uint64_slots):
+        vals, offs = block.uint64_slots[name]
+        has = offs[1:] > offs[:-1]
+        take = has & ~found
+        if take.any():
+            keys[take] = vals[offs[:-1][take]]
+            found |= has
+        if found.all():
+            break
+    return keys
+
+
 def pack_pass(blocks: Sequence[SlotRecordBlock], feed_config: DataFeedConfig,
               batch_size: int, label_slot="label",
               key_mapper=None, prebatched: bool = False,

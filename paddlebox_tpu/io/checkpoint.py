@@ -312,6 +312,40 @@ class TrainCheckpoint:
             table.load(sparse_dir(n), mode="upsert")
         return head
 
+    def read_state(self) -> Optional[Dict]:
+        """The head generation's STATE dict (day/pass cursor + any
+        ``extra`` the saver embedded — the fleet's per-trainer cursors)
+        WITHOUT loading any table or trainer state.  A restarted fleet
+        rank reads this first: mid-day it must NOT ``resume()`` (a full
+        table reload would roll back other ranks' landed write-backs on
+        a local table, and is redundant against a remote PS) — it only
+        needs the cursor, the dense restore, and a shadow table."""
+        head = self._manifest()
+        if head is None:
+            return None
+        return self._state(head)
+
+    def restore_dense(self, trainer) -> Optional[int]:
+        """Dense-only restore (params + optimizer state) from the head
+        generation — the fleet rank-restart path: sparse state lives on
+        the PS tier (nothing to reload), but the trainer's dense replica
+        must roll back to the last pass boundary so the restarted rank's
+        slice deltas are computed from the same base every surviving
+        rank used.  Returns the head generation, or None when empty."""
+        head = self._manifest()
+        if head is None:
+            return None
+        with open(os.path.join(self._gen_dir(head), "dense.msgpack"),
+                  "rb") as f:
+            dense = serialization.from_bytes(
+                {"params": jax.device_get(trainer.params),
+                 "opt_state": jax.device_get(trainer.opt_state)},
+                f.read())
+        trainer.params = dense["params"]
+        trainer.opt_state = dense["opt_state"]
+        stat_add("ckpt.dense_restores")
+        return head
+
     def resume(self, engine: BoxPSEngine, trainer) -> Optional[Dict]:
         """Restore everything from the newest committed generation (base
         load + delta-chain upserts); returns the head STATE dict or None

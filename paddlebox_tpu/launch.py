@@ -83,6 +83,16 @@ class ClusterScraper:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    def add_ports(self, ports: List[int]) -> None:
+        """Fold more workers into the scrape set — how the trainer
+        fleet's /statz exporters join the same /clusterz timeline as the
+        PS tier (launched later than the scraper, hence dynamic)."""
+        with self._lock:
+            for p in ports:
+                if p not in self._alive:
+                    self.ports.append(p)
+                    self._alive[p] = False
+
     def scrape_once(self) -> int:
         """One scrape+merge round; returns how many workers answered
         (0 appends nothing — an all-dead interval is a gap, not a zero
@@ -91,7 +101,9 @@ class ClusterScraper:
         if self.prefix:
             path += f"&prefix={self.prefix}"
         snaps = []
-        for p in self.ports:
+        with self._lock:
+            ports = list(self.ports)   # snapshot: add_ports appends live
+        for p in ports:
             snap = self._obs.scrape(p, path=path, host=self.host)
             with self._lock:
                 self._alive[p] = snap is not None
@@ -746,6 +758,97 @@ class PSFleet:
         self.reap_retired(force=True)
         for s in self.sups:
             s.stop()
+
+
+class TrainerSupervisor:
+    """``--trainers N``'s per-rank half: own one fleet-trainer rank,
+    watch it, and restart it when it dies — the trainer-tier mirror of
+    :class:`PSServerSupervisor`.
+
+    The factory builds a FULL fresh incarnation (runner + PSClient +
+    shuffle transport) because crash recovery is process-shaped: the new
+    runner reads the fleet cursor from the shared manifest, replays its
+    namespaced rid groups against the checkpoint shadow, and re-joins
+    the surviving ranks' barriers (trainer/fleet_runner.py protocol).
+    Nothing of the dead incarnation is reused, so in-proc (test) and
+    subprocess (deployment) restarts follow the same code path.
+
+    Bounded by ``max_restarts`` with exponential backoff between
+    attempts; ``join()`` surfaces the final result or re-raises the last
+    error once the budget is spent.  ``stop()`` abandons the watch and
+    joins the thread (PB405)."""
+
+    def __init__(self, runner_factory, rank: int, days,
+                 max_restarts: int = 3, backoff_base: float = 0.1,
+                 backoff_cap: float = 2.0):
+        self._factory = runner_factory
+        self.rank = int(rank)
+        self.days = days
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self._backoff = (float(backoff_base), float(backoff_cap))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"pbox-trainer-sup-{rank}",
+            daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        from paddlebox_tpu.utils.backoff import Backoff
+        from paddlebox_tpu.utils.monitor import stat_add, stat_observe
+        bo = Backoff(base=self._backoff[0], cap=self._backoff[1])
+        t_crash: Optional[float] = None
+        while not self._stop.is_set():
+            try:
+                runner = self._factory(self.rank)
+            except BaseException as e:  # noqa: BLE001 — factory = restart
+                self.error = e
+                runner = None
+            if runner is not None:
+                if t_crash is not None:
+                    # MTTR from observed death to the replacement
+                    # incarnation (fresh client + transport, rebuilt by
+                    # the factory) entering run() — what the bench's
+                    # restart_mttr_s gate measures
+                    stat_observe("trainer.fleet.restart_mttr_s",
+                                 time.monotonic() - t_crash)
+                    t_crash = None
+                try:
+                    self.result = runner.run(self.days)
+                    self.error = None
+                    return
+                except BaseException as e:  # noqa: BLE001 — any death restarts
+                    self.error = e
+            if self.restarts >= self.max_restarts:
+                flight.record("supervisor_give_up", role="trainer",
+                              rank=self.rank, restarts=self.restarts)
+                return
+            self.restarts += 1
+            if t_crash is None:
+                t_crash = time.monotonic()
+            flight.record("trainer_restart", rank=self.rank,
+                          restart=self.restarts,
+                          error=type(self.error).__name__)
+            stat_add("trainer.supervisor.restarts")
+            bo.sleep(self.restarts)
+
+    def join(self, timeout: Optional[float] = None):
+        """Wait for the supervised rank to finish; returns its result or
+        re-raises its terminal error (restart budget spent)."""
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError(
+                f"trainer rank {self.rank} still running after "
+                f"{timeout}s")
+        if self.result is None and self.error is not None:
+            raise self.error
+        return self.result
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30.0)
 
 
 class PSElasticWatcher:

@@ -228,7 +228,8 @@ class _InflightBudget:
 
 def two_phase_lifecycle(client, verb: str, table: Optional[str] = None,
                         timeout: float = 60.0,
-                        extra: Optional[Dict] = None):
+                        extra: Optional[Dict] = None,
+                        group: Optional[str] = None):
     """Run a decaying lifecycle verb cluster-wide, exactly once per shard.
 
     n == 1 degrades to the plain single-server dedup'd send (byte- and
@@ -243,6 +244,16 @@ def two_phase_lifecycle(client, verb: str, table: Optional[str] = None,
     crashed and lost its staged migration state can execute the commit
     from the frame alone (the same self-containment the commit verb
     already has).
+
+    ``group`` pins a CALLER-deterministic rid group instead of the
+    client-private pin in ``_txn_groups``: a verb that must stay
+    exactly-once across a caller PROCESS death (the trainer fleet's
+    end_day, re-driven by whichever rank wins the leader lease) derives
+    the group from durable coordinates (day id), so every driver —
+    original leader, failover leader, the restarted original — replays
+    the same rids through the dedup windows.  The n == 1 degenerate path
+    pins ``<group>.c0`` for the same reason (the plain send otherwise
+    mints a fresh rid per attempt).
     """
     if verb not in LIFECYCLE_VERBS:
         raise ValueError(f"not a cluster lifecycle verb: {verb!r}")
@@ -255,14 +266,18 @@ def two_phase_lifecycle(client, verb: str, table: Optional[str] = None,
     # pinned rid group makes the replay exactly-once per shard)
     stamp = getattr(client, "_stamp_ep", None) or (lambda r: r)
     if n <= 1:
-        return client._call(stamp({"cmd": verb, "table": table, **extra}),
-                            dedup=True, timeout=timeout)
+        req = {"cmd": verb, "table": table, **extra}
+        if group is not None:
+            req[wire.RID_FIELD] = f"{group}.c0"
+        return client._call(stamp(req), dedup=True, timeout=timeout)
     t0 = time.perf_counter()
     txn_key = (verb, table or "")
-    group = client._txn_groups.get(txn_key)
+    pinned = group
     if group is None:
-        group = client.new_rid_group()
-        client._txn_groups[txn_key] = group
+        group = client._txn_groups.get(txn_key)
+        if group is None:
+            group = client.new_rid_group()
+            client._txn_groups[txn_key] = group
     prepared: List[int] = []
     try:
         for shard in range(n):
@@ -291,7 +306,8 @@ def two_phase_lifecycle(client, verb: str, table: Optional[str] = None,
                                   "table": table, "txn": group, **extra,
                                   wire.RID_FIELD: f"{group}.c{shard}"}),
                            shard=shard, timeout=timeout)
-    client._txn_groups.pop(txn_key, None)
+    if pinned is None:
+        client._txn_groups.pop(txn_key, None)
     stat_add("ps.cluster.lifecycle_commit")
     stat_observe("ps.cluster.lifecycle_s", time.perf_counter() - t0)
     return out

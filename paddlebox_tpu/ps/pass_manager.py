@@ -106,11 +106,19 @@ class BoxPSEngine:
         self._ws_buffers: Dict[str, np.ndarray] = {}
 
     # -- date / phase --------------------------------------------------------
-    def set_date(self, date: str) -> None:
+    def set_date(self, date: str, *, table_decay: bool = True) -> None:
+        """Advance the engine's day.  ``table_decay=False`` keeps the
+        local day bookkeeping (quality rollover, cache invalidation) but
+        skips the ``table.end_day()`` decay — the trainer fleet's mode,
+        where exactly ONE rank (the elected leader) drives the decay
+        through the 2-phase lifecycle verb and every engine merely
+        adopts the new date; N engines each decaying the shared remote
+        table would compound the decay N times."""
         if self.day_id is not None and date != self.day_id:
             flight.record("day_end", day=self.day_id, next_day=date)
-            with self.timers("end_day"):
-                self.table.end_day()
+            if table_decay:
+                with self.timers("end_day"):
+                    self.table.end_day()
             # day-scale concept-drift rollover (quality.psi.day)
             quality.end_day(self.day_id)
             # coherence point: end_day decayed show/click table-wide —

@@ -2598,14 +2598,21 @@ class PSClient:
                 {"cmd": "shrink", "table": table}))["removed"]
         return self._control_fenced(run)
 
-    def end_day(self, table: Optional[str] = None) -> None:
+    def end_day(self, table: Optional[str] = None,
+                group: Optional[str] = None) -> None:
         # non-idempotent (counter decay) → exactly-once via rid; cluster-
         # wide it is 2-phase over every shard's dedup window — ALL shards
         # decay or none (ps/cluster.two_phase_lifecycle; lint rule PB801
-        # keeps every lifecycle send on this path)
+        # keeps every lifecycle send on this path).  ``group`` pins a
+        # caller-deterministic rid group: the trainer fleet's leader
+        # failover re-drives end_day under the SAME group from whichever
+        # rank holds the lease, and the dedup windows collapse the
+        # duplicates — decay happens exactly once per day regardless of
+        # how many leaders attempted it.
         self._control_fenced(
             lambda: ps_cluster.two_phase_lifecycle(self, "end_day",
-                                                   table=table))
+                                                   table=table,
+                                                   group=group))
 
     def size(self, table: Optional[str] = None) -> int:
         if self.n_shards > 1:
@@ -2695,22 +2702,34 @@ class PSClient:
         resp["wire_dtype"] = self.wire_dtype
         return resp
 
-    def barrier(self, world: int, timeout: float = 120) -> None:
+    def barrier(self, world: int, timeout: float = 120,
+                rid: Optional[str] = None) -> None:
         # retryable via rid: a resend after a dropped connection WAITS on
         # the original registration server-side instead of double-
         # registering.  Client timeout stays LONGER than the server's wait
         # window, so the server side always resolves (release or
-        # rollback) first.
-        self._call({"cmd": "barrier", "world": world}, timeout=timeout,
-                   deadline=2 * timeout, dedup=True)
+        # rollback) first.  ``rid`` pins a caller-deterministic request id
+        # (the trainer fleet's replay-safe barriers: a restarted rank
+        # re-driving its pass replays the SAME rid, so a barrier it
+        # already joined answers from the dedup window instead of
+        # double-registering).
+        req: Dict = {"cmd": "barrier", "world": world}
+        if rid is not None:
+            req[wire.RID_FIELD] = rid
+        self._call(req, timeout=timeout, deadline=2 * timeout, dedup=True)
 
     def allreduce(self, arrs: Dict[str, np.ndarray], world: int, key: str,
-                  timeout: float = 120) -> Dict[str, np.ndarray]:
+                  timeout: float = 120,
+                  rid: Optional[str] = None) -> Dict[str, np.ndarray]:
         """Sum the named arrays across `world` workers (every caller gets
-        the same result).  Exactly-once like barrier (rid-dedup'd resend).
+        the same result).  Exactly-once like barrier (rid-dedup'd resend;
+        ``rid`` pins a caller-deterministic id for restart replay).
         Use a fresh key per collective (e.g. f"auc-{pass_id}")."""
-        out = self._call({"cmd": "allreduce", "key": key, "world": world,
-                          "arrs": dict(arrs)}, timeout=timeout,
+        req: Dict = {"cmd": "allreduce", "key": key, "world": world,
+                     "arrs": dict(arrs)}
+        if rid is not None:
+            req[wire.RID_FIELD] = rid
+        out = self._call(req, timeout=timeout,
                          deadline=2 * timeout, dedup=True)
         return out["arrs"]
 
@@ -2848,6 +2867,18 @@ class RemoteTableAdapter:
         digest = np.asarray(full_keys, np.uint64).tobytes()
         self._snaps[digest] = {f: np.array(v, copy=True)
                                for f, v in rows.items()}
+
+    def pin_group(self, keys, group: str) -> None:
+        """Pre-pin the rid group the NEXT ``bulk_write(keys, ...)`` will
+        send its chunks under (instead of a fresh ``new_rid_group()``).
+        The trainer fleet pins a group deterministic in (rank, day, pass,
+        slice) right before each slice's write-back, so a crashed rank's
+        replayed end_pass re-drives byte-identical chunks under identical
+        rids — landed chunks dedup server-side, unlanded ones apply
+        exactly once."""
+        if not self.delta_mode:
+            return
+        self._snap_groups[np.asarray(keys, np.uint64).tobytes()] = group
 
     def bulk_write(self, keys, soa):
         if not self.delta_mode:

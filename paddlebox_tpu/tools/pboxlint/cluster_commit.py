@@ -38,6 +38,20 @@ a reason for caller code to hand-build these frames.
          either reject the traffic (wrong_epoch) or, worse, accept
          writes addressed by a partition no one else agrees on.
 
+  PB806  a rid-group LITERAL handed to a lifecycle/push verb from the
+         trainer-fleet modules (``trainer/``, ``fleet.py``,
+         ``parallel/collective.py``) whose pre-colon dedup token carries
+         no ``.t<rank>`` trainer namespace.  The fleet's exactly-once
+         story is per-trainer rid namespacing: rank r's replayed chunks
+         may only dedup against rank r's own landed chunks, so every
+         group token must be either rank-suffixed or minted by the
+         sanctioned ``parallel.collective.namespaced_group()`` helper
+         (whose ``rank=None`` form is the leader-failover namespace —
+         the ONE sanctioned un-suffixed shape, for verbs that must stay
+         exactly-once across a leader change).  A bare literal that
+         spells neither is a replay-collision bug waiting for the first
+         trainer restart.
+
 ``ps/cluster.py`` and ``ps/reshard.py`` (the implementations) and test
 files are exempt.
 """
@@ -56,6 +70,13 @@ _CLUSTER_VERBS = ("end_day", "lifecycle_prepare", "lifecycle_commit",
 _MEMBER_VERBS = ("end_day", "save", "load")
 _EXEMPT_PATHS = ("/ps/cluster.py", "/ps/reshard.py")
 _MAP_ATTRS = ("addrs", "epoch")
+
+# PB806 scope: the trainer-fleet modules whose rid groups MUST be
+# per-trainer namespaced (or minted by namespaced_group)
+_FLEET_PATHS = ("/fleet.py", "/parallel/collective.py")
+_FLEET_DIRS = ("/trainer/",)
+_GROUP_KWARGS = ("group", "rid_group", "rid")
+_GROUP_POS_VERBS = {"pin_group": 1}    # verb -> positional index of group
 
 
 def _send_name(func: ast.AST) -> str:
@@ -77,6 +98,33 @@ def _frame_verb(node: ast.Call) -> Optional[str]:
                 and isinstance(v.value, str):
             return v.value
     return None
+
+
+def _in_fleet_scope(path: str) -> bool:
+    return any(path.endswith(p) for p in _FLEET_PATHS) \
+        or any(d in path for d in _FLEET_DIRS)
+
+
+def _group_token_unnamespaced(node: ast.AST) -> bool:
+    """True when ``node`` is a compile-time group string whose dedup
+    token (text before the first ``:``) visibly lacks the ``.t<rank>``
+    trainer namespace.  Names/calls (``namespaced_group(...)`` results)
+    are not literals and never flag; an f-string passes as soon as a
+    constant fragment shows ``.t`` before the colon."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        token = node.value.split(":", 1)[0]
+        return ".t" not in token
+    if isinstance(node, ast.JoinedStr):
+        for part in node.values:
+            if isinstance(part, ast.Constant) \
+                    and isinstance(part.value, str):
+                head, colon, _ = part.value.partition(":")
+                if ".t" in head:
+                    return False
+                if colon:
+                    return True          # token closed without namespace
+        return True                      # no visible namespace anywhere
+    return False
 
 
 def _receiver_subscripted(func: ast.Attribute) -> bool:
@@ -120,6 +168,25 @@ def check(mod: Module, ctx: PackageContext) -> List[Finding]:
                 "single-shard lifecycle send forks the cluster — call it "
                 "on the sharded client (which fans out 2-phase / through "
                 "the cluster MANIFEST) instead"))
+        if _in_fleet_scope(path):
+            group_vals = [kw.value for kw in node.keywords
+                          if kw.arg in _GROUP_KWARGS]
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _GROUP_POS_VERBS:
+                idx = _GROUP_POS_VERBS[node.func.attr]
+                if len(node.args) > idx:
+                    group_vals.append(node.args[idx])
+            for gv in group_vals:
+                if _group_token_unnamespaced(gv):
+                    findings.append(Finding(
+                        mod.path, node.lineno, "PB806",
+                        "rid-group literal without a trainer namespace: "
+                        "the dedup token (text before ':') must carry "
+                        ".t<rank> so a restarted trainer's replay can "
+                        "only dedup against its OWN landed chunks — "
+                        "mint groups via parallel.collective."
+                        "namespaced_group() (rank=None is the sanctioned "
+                        "leader-failover namespace)"))
         if _send_name(node.func) == "ServerMap":
             findings.append(Finding(
                 mod.path, node.lineno, "PB803",

@@ -35,6 +35,17 @@ soak asserts runtime ⊆ static.
          loop — wakeups are advisory (spurious wakeup / missed
          predicate).  ``wait(timeout)`` outside a loop is an
          interruptible sleep and is fine.
+  PB605  (PB604 family, fleet collectives) an unbounded retry of a
+         fleet collective/barrier wait: a ``while True`` loop in the
+         collective-wait modules (parallel/collective.py,
+         trainer/fleet_runner.py, data/shuffle_transport.py) that
+         swallows ``ConnectionError``/``OSError``/``RuntimeError`` yet
+         carries no deadline evidence — no ``time.monotonic()``
+         comparison and no ``Backoff(deadline=...)``.  The fleet
+         robustness contract (PB604 discipline applied to peers) is
+         that EVERY wait on another trainer is deadline-bounded and
+         expiry raises the typed PeerDead/ShufflePeerDead — an
+         unbounded retry turns one dead peer into a hung fleet.
 
 Unknown call targets *widen* the analysis (CHA fallback to every
 same-named package method) — the caller's held-set is never dropped.
@@ -547,9 +558,107 @@ def analyze_paths(paths: Sequence[str]) -> LockAnalysis:
     return analyze(mods)
 
 
+# -- PB605: unbounded fleet-collective retry (module-local scan) -----------
+
+_COLLECTIVE_WAIT_PATHS = ("/parallel/collective.py",
+                          "/trainer/fleet_runner.py",
+                          "/data/shuffle_transport.py")
+_RETRY_EXC_NAMES = {"ConnectionError", "OSError", "RuntimeError",
+                    "socket.error"}
+
+
+def _handler_catches_retryable(handler: ast.ExceptHandler) -> bool:
+    types = []
+    t = handler.type
+    if isinstance(t, ast.Tuple):
+        types = list(t.elts)
+    elif t is not None:
+        types = [t]
+    for ty in types:
+        name = dotted_name(ty) or (ty.id if isinstance(ty, ast.Name)
+                                   else "")
+        if name.rpartition(".")[2] in {n.rpartition(".")[2]
+                                       for n in _RETRY_EXC_NAMES}:
+            return True
+    return False
+
+
+def _handler_exits_loop(handler: ast.ExceptHandler) -> bool:
+    """A handler whose body unconditionally leaves the loop (return /
+    raise / break as its last statement) is an exit path, not a retry —
+    an accept-loop's ``except OSError: return`` shutdown is fine."""
+    if not handler.body:
+        return False
+    return isinstance(handler.body[-1], (ast.Return, ast.Raise, ast.Break))
+
+
+_TEARDOWN_VERBS = {"close", "shutdown"}
+
+
+def _try_is_teardown(t: ast.Try) -> bool:
+    """``try: sock.close() except OSError: pass`` is a cleanup swallow,
+    not a retry of a peer wait — every statement in the try body is a
+    bare call to a teardown verb."""
+    for stmt in t.body:
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr in _TEARDOWN_VERBS):
+            return False
+    return bool(t.body)
+
+
+def _loop_has_deadline_evidence(loop: ast.While) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            attr = fn.attr if isinstance(fn, ast.Attribute) else \
+                (fn.id if isinstance(fn, ast.Name) else "")
+            if attr == "monotonic":
+                return True
+            if attr == "Backoff" and any(kw.arg == "deadline"
+                                         for kw in node.keywords):
+                return True
+            # a Backoff built just outside the loop: its .sleep() result
+            # gating a raise/return IS the deadline check
+            if attr == "sleep" and isinstance(fn, ast.Attribute):
+                return True
+    return False
+
+
+def _check_pb605(mod: Module) -> List[Finding]:
+    path = mod.path.replace("\\", "/")
+    if not any(path.endswith(p) for p in _COLLECTIVE_WAIT_PATHS):
+        return []
+    findings: List[Finding] = []
+    for node in mod.walk():
+        if not (isinstance(node, ast.While)
+                and isinstance(node.test, ast.Constant)
+                and node.test.value is True):
+            continue
+        catches = [h for t in ast.walk(node) if isinstance(t, ast.Try)
+                   and not _try_is_teardown(t)
+                   for h in t.handlers if _handler_catches_retryable(h)
+                   and not _handler_exits_loop(h)]
+        if not catches:
+            continue
+        if _loop_has_deadline_evidence(node):
+            continue
+        findings.append(Finding(
+            mod.path, node.lineno, "PB605",
+            "unbounded fleet-collective retry: this while-True loop "
+            "swallows connection errors with no deadline evidence "
+            "(time.monotonic() comparison or Backoff(deadline=...)/"
+            ".sleep() budget) — every wait on a peer must be bounded "
+            "and raise the typed PeerDead/ShufflePeerDead on expiry, "
+            "or one dead trainer hangs the whole fleet"))
+    return findings
+
+
 def check(mod: Module, ctx: PackageContext) -> List[Finding]:
     cache = getattr(ctx, "_lockgraph", None)
     if cache is None:
         cache = analyze(ctx.modules)
         ctx._lockgraph = cache
-    return [f for f in cache.findings if f.path == mod.path]
+    return [f for f in cache.findings if f.path == mod.path] \
+        + _check_pb605(mod)

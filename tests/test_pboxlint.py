@@ -1437,3 +1437,158 @@ def test_pb803_suppression_escape():
         self.epoch = n
     """
     assert codes(src) == []
+
+
+# -- PB806 trainer-namespaced rid groups -------------------------------------
+
+def test_pb806_bare_group_literal_in_trainer_scope():
+    src = """
+    def push(client, grads):
+        client.push_sparse(grads, group="fleet.d:chunk0")
+    """
+    assert codes(src, path="paddlebox_tpu/trainer/push.py") == ["PB806"]
+
+
+def test_pb806_rank_suffixed_literal_ok():
+    src = """
+    def push(client, grads):
+        client.push_sparse(grads, group="fleet.d.t0:chunk0")
+    """
+    assert codes(src, path="paddlebox_tpu/trainer/push.py") == []
+
+
+def test_pb806_namespaced_group_helper_ok():
+    # the sanctioned mint: not a literal, never flagged (rank=None is the
+    # leader-failover namespace and also routes through the helper)
+    src = """
+    def push(client, grads, rank):
+        client.push_sparse(grads,
+                           group=namespaced_group("fleet.d", rank, "c0"))
+        client.end_day(table=None,
+                       group=namespaced_group("fleet.day", None, "d0"))
+    """
+    assert codes(src, path="paddlebox_tpu/trainer/push.py") == []
+
+
+def test_pb806_fstring_group_without_namespace():
+    src = """
+    def push(client, grads, v):
+        client.push_sparse(grads, group=f"fleet.d:{v}")
+    """
+    assert codes(src, path="paddlebox_tpu/fleet.py") == ["PB806"]
+
+
+def test_pb806_fstring_group_with_rank_namespace_ok():
+    src = """
+    def push(client, grads, rank, v):
+        client.push_sparse(grads, group=f"fleet.d.t{rank}:{v}")
+    """
+    assert codes(src, path="paddlebox_tpu/fleet.py") == []
+
+
+def test_pb806_pin_group_positional():
+    src = """
+    def writeback(adapter, rank):
+        adapter.pin_group(None, "fleet.wb:turn")
+    """
+    assert codes(src, path="paddlebox_tpu/trainer/runner.py") == ["PB806"]
+
+
+def test_pb806_out_of_scope_module_silent():
+    # PS-side code owns its own rid discipline — the trainer namespace
+    # rule only binds the fleet/trainer modules
+    src = """
+    def push(client, grads):
+        client.push_sparse(grads, group="ps.local:chunk0")
+    """
+    assert codes(src, path="paddlebox_tpu/ps/engine_util.py") == []
+
+
+def test_pb806_suppression_escape():
+    src = """
+    def push(client, grads):
+        # pboxlint: disable-next=PB806 -- single-trainer bootstrap path
+        client.push_sparse(grads, group="fleet.d:chunk0")
+    """
+    assert codes(src, path="paddlebox_tpu/trainer/push.py") == []
+
+
+# -- PB605 bounded fleet-collective retries (PB604 family) -------------------
+
+def test_pb605_unbounded_retry_in_collective():
+    src = """
+    def pump(self, frame):
+        while True:
+            try:
+                self._send(frame)
+                return
+            except ConnectionError:
+                continue
+    """
+    assert codes(src, path="paddlebox_tpu/parallel/collective.py") \
+        == ["PB605"]
+
+
+def test_pb605_monotonic_deadline_ok():
+    src = """
+    import time
+
+    def pump(self, frame, deadline):
+        while True:
+            try:
+                self._send(frame)
+                return
+            except ConnectionError:
+                if time.monotonic() > deadline:
+                    raise PeerDead("send")
+    """
+    assert codes(src, path="paddlebox_tpu/parallel/collective.py") == []
+
+
+def test_pb605_backoff_budget_ok():
+    # a Backoff built outside the loop: its .sleep() verdict gating the
+    # raise IS the deadline evidence
+    src = """
+    def pump(self, frame, bo):
+        attempt = 0
+        while True:
+            try:
+                self._send(frame)
+                return
+            except OSError:
+                attempt += 1
+                if not bo.sleep(attempt):
+                    raise PeerDead("send")
+    """
+    assert codes(src, path="paddlebox_tpu/parallel/collective.py") == []
+
+
+def test_pb605_exit_handler_and_teardown_swallow_ok():
+    # an accept loop's `except OSError: return` is shutdown, not retry,
+    # and `try: conn.close() except OSError: pass` is a cleanup swallow
+    src = """
+    def accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                conn.close()
+            except OSError:
+                pass
+    """
+    assert codes(src, path="paddlebox_tpu/data/shuffle_transport.py") == []
+
+
+def test_pb605_out_of_scope_module_silent():
+    src = """
+    def pump(self, frame):
+        while True:
+            try:
+                self._send(frame)
+                return
+            except ConnectionError:
+                continue
+    """
+    assert codes(src, path="paddlebox_tpu/ps/service.py") == []

@@ -1,0 +1,280 @@
+"""Trainer-fleet data parallelism: N trainers x M PS shards with the
+crash-anywhere exactly-once contract (ISSUE 17 tentpole).
+
+The determinism anchor is the virtual-slice protocol: records route to a
+fixed V slices by key (independent of fleet width), rank r owns slices
+v % N == r, and every order-sensitive fold (training, write-back, dense
+allreduce, metric union) runs in ascending v — so N=1 and N=4 produce
+bit-identical losses, dense params, and sparse tables, and a trainer
+killed at ANY lifecycle site converges to the same bits after its
+supervisor restart (namespaced rid-group replay + shadow-table pull
+recompute the identical deltas; the PS dedups them).
+
+Tier-1 proves: N=1 vs N=4 serial AND prefetched, a seeded kill
+mid-shuffle and mid-allreduce recovered through TrainerSupervisor, and
+leader death handing lifecycle duties over without double-applying
+end_day (bit-identity IS the exactly-once witness: a doubled decay would
+fork the table).  The slow soak sweeps kill sites x ranks over the full
+2-day x 3-pass schedule.
+"""
+
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from test_end_to_end import MF_DIM, N_SLOTS, feed_config, gen_data
+
+from paddlebox_tpu import flags
+from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+from paddlebox_tpu.data.shuffle_transport import (ShufflePeerDead,
+                                                  TcpShuffleTransport)
+from paddlebox_tpu.fleet import run_trainer_fleet
+from paddlebox_tpu.launch import PSFleet
+from paddlebox_tpu.models.deepfm import DeepFM
+from paddlebox_tpu.ps import cluster as ps_cluster
+from paddlebox_tpu.ps import faults
+from paddlebox_tpu.ps.host_table import ShardedHostTable
+from paddlebox_tpu.ps.service import PSClient
+from paddlebox_tpu.trainer.fleet_runner import _Membership
+from paddlebox_tpu.utils import flight
+from paddlebox_tpu.utils.monitor import stat_snapshot
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _fleet_flags():
+    old = {k: flags.get_flags(k) for k in
+           ("shuffle_deadline_s", "fleet_deadline_s", "fleet_hb_ttl_s")}
+    flags.set_flags({"shuffle_deadline_s": 20.0,
+                     "fleet_deadline_s": 45.0,
+                     "fleet_hb_ttl_s": 1.0})
+    yield
+    flags.set_flags(old)
+
+
+def _tcfg():
+    return EmbeddingTableConfig(embedding_dim=MF_DIM, shard_num=4,
+                                sgd=SparseSGDConfig(mf_create_thresholds=2.0))
+
+
+def _model_fn():
+    return DeepFM(num_slots=N_SLOTS, emb_width=3 + MF_DIM, dense_dim=2,
+                  hidden=(16, 8))
+
+
+# fixed ports BELOW the ephemeral range (32768+): a restarted rank
+# re-binds its OWN address, which must not be squattable as some
+# concurrent outbound connection's local port
+_PORT_BASE = [24100]
+
+
+def _free_ports(n):
+    out = []
+    while len(out) < n:
+        _PORT_BASE[0] += 1
+        try:
+            s = socket.socket()
+            s.bind(("127.0.0.1", _PORT_BASE[0]))
+            s.close()
+            out.append(_PORT_BASE[0])
+        except OSError:
+            pass
+    return out
+
+
+@pytest.fixture(scope="module")
+def fleet_data(tmp_path_factory):
+    """2 days x 3 passes x 2 files (the acceptance schedule)."""
+    root = tmp_path_factory.mktemp("fleet-data")
+    files = []
+    for i in range(12):
+        p = str(root / f"f{i}.txt")
+        gen_data(p, n=150, seed=i)
+        files.append(p)
+    days = [("20260701", [files[0:2], files[2:4], files[4:6]]),
+            ("20260702", [files[6:8], files[8:10], files[10:12]])]
+    return days
+
+
+def _run_fleet(tmp_path, days, world, m_shards, tag, *, prefetch=False,
+               fault_plans=None):
+    """One fleet run against a fresh M-shard PS cluster; returns the
+    per-rank results plus a full-table dump directory."""
+    flt = PSFleet(m_shards, _tcfg(), seed=1)
+    try:
+        addrs = ([("127.0.0.1", p) for p in _free_ports(world)]
+                 if world > 1 else None)
+        results = run_trainer_fleet(
+            world, flt.addrs, str(tmp_path / f"wd-{tag}"), _tcfg(),
+            _model_fn, feed_config(), days, batch_size=64,
+            virtual_shards=4, table_seed=1, trainer_seed=2,
+            prefetch=prefetch, trainer_addrs=addrs,
+            fault_plans=fault_plans, client_deadline=30.0)
+        dump = str(tmp_path / f"dump-{tag}")
+        PSClient(flt.addrs, deadline=30.0).save(dump, mode="all")
+        return results, dump
+    finally:
+        flt.stop()
+
+
+def _load_dump(dump):
+    t = ShardedHostTable(_tcfg(), seed=1)
+    w = ps_cluster.dump_width(dump)
+    if w <= 1:
+        t.load(dump, mode="upsert")
+    else:
+        for k in range(w):
+            t.load(ps_cluster.shard_dir(dump, k), mode="upsert")
+    return t
+
+
+def _all_keys(t):
+    parts = [np.asarray(s.keys, np.uint64) for s in t._shards
+             if len(s.keys)]
+    return np.sort(np.concatenate(parts)) if parts else \
+        np.empty(0, np.uint64)
+
+
+def _assert_bit_identical(base, other):
+    """Histories, dense params (every rank), and the full sparse table."""
+    res_b, dump_b = base
+    res_o, dump_o = other
+    hb, ho = res_b[0]["history"], res_o[0]["history"]
+    assert len(hb) == len(ho) and len(hb) > 0
+    for a, b in zip(hb, ho):
+        assert a["loss"] == b["loss"], (a, b)
+        assert a["auc"] == b["auc"], (a, b)
+        assert a["batches"] == b["batches"], (a, b)
+    pb = jax.tree_util.tree_leaves(res_b[0]["params"])
+    for res in res_o:
+        pr = jax.tree_util.tree_leaves(res["params"])
+        for x, y in zip(pb, pr):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), \
+                "dense params differ"
+    tb, to = _load_dump(dump_b), _load_dump(dump_o)
+    kb, ko = _all_keys(tb), _all_keys(to)
+    assert np.array_equal(kb, ko), (len(kb), len(ko))
+    assert len(kb) > 0
+    rb, ro = tb.bulk_pull(kb), to.bulk_pull(ko)
+    for f in rb:
+        assert np.array_equal(rb[f], ro[f]), f"table field {f} differs"
+
+
+@pytest.fixture(scope="module")
+def baseline(tmp_path_factory, fleet_data):
+    """The N=1 serial run every width/chaos variant must match."""
+    tmp = tmp_path_factory.mktemp("fleet-base")
+    return _run_fleet(tmp, fleet_data, 1, 1, "n1")
+
+
+# -- bit-identity across fleet width -----------------------------------------
+
+def test_n4_serial_bit_identical(tmp_path, fleet_data, baseline):
+    out = _run_fleet(tmp_path, fleet_data, 4, 2, "n4")
+    _assert_bit_identical(baseline, out)
+    snap = stat_snapshot()
+    for name in ("trainer.fleet.shuffle_tx_bytes",
+                 "trainer.fleet.shuffle_rx_bytes",
+                 "trainer.fleet.barrier_wait_s",
+                 "trainer.fleet.allreduce_wait_s",
+                 "trainer.fleet.straggler_gap_s"):
+        assert any(k.startswith(name) for k in snap), name
+
+
+def test_n4_prefetched_bit_identical(tmp_path, fleet_data, baseline):
+    out = _run_fleet(tmp_path, fleet_data, 4, 2, "n4pf", prefetch=True)
+    _assert_bit_identical(baseline, out)
+
+
+def test_n1_prefetched_bit_identical(tmp_path, fleet_data, baseline):
+    out = _run_fleet(tmp_path, fleet_data, 1, 1, "n1pf", prefetch=True)
+    _assert_bit_identical(baseline, out)
+
+
+# -- crash-anywhere: kill a trainer mid-pass ---------------------------------
+
+@pytest.mark.parametrize("site", ["fleet_shuffle", "fleet_allreduce"])
+def test_kill_trainer_mid_pass_recovers(tmp_path, fleet_data, baseline,
+                                        site):
+    """Seeded kill of rank 1 mid-shuffle / mid-allreduce: the
+    TrainerSupervisor restarts it, the namespaced rid replay + shuffle
+    resync recover the pass, and the result is bit-identical."""
+    before = len(flight.events(kind="trainer_restart"))
+    plan = faults.FaultPlan(seed=7).kill_at(site, at=(1,))
+    out = _run_fleet(tmp_path, fleet_data, 2, 2, f"chaos-{site}",
+                     fault_plans={1: plan})
+    _assert_bit_identical(baseline, out)
+    after = flight.events(kind="trainer_restart")   # newest-first
+    restarts = [e for e in after[:len(after) - before]
+                if e.get("rank") == 1]
+    assert restarts, "supervisor restart never recorded"
+
+
+def test_kill_leader_mid_pass_end_day_exactly_once(tmp_path, fleet_data,
+                                                   baseline):
+    """Kill rank 0 — the elected leader — during a write-back turn: the
+    surviving rank's barrier pokes take over the lifecycle duties under
+    the rank=None failover namespace, the restarted leader replays, and
+    end_day lands exactly once (a doubled decay would fork the table
+    and break bit-identity)."""
+    plan = faults.FaultPlan(seed=11).kill_at("end_pass", at=(1,))
+    out = _run_fleet(tmp_path, fleet_data, 2, 2, "chaos-leader",
+                     fault_plans={0: plan})
+    _assert_bit_identical(baseline, out)
+
+
+# -- leader election ---------------------------------------------------------
+
+def test_membership_reelection_and_rejoin(tmp_path):
+    m0 = _Membership(str(tmp_path), 0, 2, ttl_s=0.3)
+    m1 = _Membership(str(tmp_path), 1, 2, ttl_s=0.3)
+    m0.heartbeat()
+    m1.heartbeat()
+    assert m1.leader() == 0
+    before = len(flight.events(kind="leader_elect"))
+    time.sleep(0.5)          # rank 0 stops beating -> TTL expiry
+    m1.heartbeat()
+    assert m1.leader() == 1
+    elects = flight.events(kind="leader_elect")     # newest-first
+    assert any(e.get("leader") == 1 and e.get("observer") == 1
+               for e in elects[:len(elects) - before])
+    m0.heartbeat()           # the restarted rank rejoins
+    assert m1.leader() == 0
+
+
+# -- transport deadline (satellite: typed peer-death) ------------------------
+
+def test_shuffle_barrier_deadline_raises_typed(tmp_path):
+    old = flags.get_flags("shuffle_deadline_s")
+    flags.set_flags({"shuffle_deadline_s": 1.5})
+    try:
+        addrs = [("127.0.0.1", p) for p in _free_ports(2)]
+        tr = TcpShuffleTransport(0, addrs)   # peer rank 1 never starts
+        try:
+            tr.set_epoch(0)
+            with pytest.raises(ShufflePeerDead):
+                tr.barrier()
+        finally:
+            tr.close()
+    finally:
+        flags.set_flags({"shuffle_deadline_s": old})
+
+
+# -- slow soak: kill anywhere ------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", ["fleet_shuffle", "end_pass",
+                                  "fleet_allreduce"])
+@pytest.mark.parametrize("rank", [0, 1])
+def test_soak_kill_anywhere_bit_identical(tmp_path, fleet_data, baseline,
+                                          site, rank):
+    """2-day soak sweep: any rank killed at any lifecycle site still
+    converges to the N=1 bits through the supervisor restart."""
+    plan = faults.FaultPlan(seed=13 + rank).kill_at(site, at=(1,))
+    out = _run_fleet(tmp_path, fleet_data, 2, 2,
+                     f"soak-{site}-r{rank}", fault_plans={rank: plan})
+    _assert_bit_identical(baseline, out)
