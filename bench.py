@@ -20,7 +20,11 @@ TrainFilesWithProfiler, boxps_worker.cc:1358):
    are measured; the watchdog emits the best value seen so far plus the
    name of the wedged phase — never a bare 0.0;
  * each phase has its own budget; a wedged phase fails fast;
- * `step_ms` breaks the device step into pull/dense/push phases.
+ * `step_ms` breaks the device step into pull/dense/push phases for the
+   SELECTED sparse step path (BENCH_SPARSE_PATH, default ragged) and
+   profiles the padded-dense fast path side by side: `sparse_share` =
+   sparse / (sparse + dense) device time, `ragged_speedup` = fast-path
+   sparse time / selected-path sparse time.
 
 Geometry (full): 26 sparse slots with variable lengths 1..3 (capacity 3),
 13 dense features, mf_dim=8, 2M-key working set, B=16384.
@@ -329,28 +333,31 @@ def _make_blocks(rng, n_records, sparse_names, n_keys, dense_dim, cap,
 
 
 def _profile_step_phases(trainer, feed, k=8):
-    """Per-phase device-time breakdown of the mxu packed step (≙ the
-    per-op timer discipline of TrainFilesWithProfiler,
-    boxps_worker.cc:1358-1407).  Each phase runs k chained iterations
-    inside one jit (a scalar carry defeats CSE and amortizes RPC latency),
-    synced by a scalar readback; the no-op floor is subtracted."""
+    """Per-phase device-time breakdown of the packed step (≙ the per-op
+    timer discipline of TrainFilesWithProfiler, boxps_worker.cc:1358-1407).
+    Each phase runs k chained iterations inside one jit (a scalar carry
+    defeats CSE and amortizes RPC latency), synced by a scalar readback;
+    the no-op floor is subtracted.
+
+    Profiles the SELECTED step path's pull/dense/push phases AND the
+    padded-dense fast path's pull/push side by side, so every record
+    carries the comparison the ragged path exists to win:
+    `sparse_share` = sparse / (sparse + dense), `ragged_speedup` =
+    fast sparse time / selected sparse time."""
     import jax
     import jax.numpy as jnp
-    from paddlebox_tpu.ps import mxu_path
+    from paddlebox_tpu.ps import fast_path, mxu_path, ragged_path
+    from paddlebox_tpu.data.pass_feed import plan_tuple
 
+    path = trainer._resolve_path()
     ws = trainer.engine.ws
     n_rows = ws["show"].shape[0]
     n, s, l, b = feed.data["indices"].shape
-    dims = mxu_path.make_dims(s * l * b, n_rows)
     interpret = jax.default_backend() == "cpu"
-    from paddlebox_tpu.data.pass_feed import plan_tuple
-    plan = plan_tuple(jax.tree.map(lambda a: a[0], feed.plans))
     bt = jax.tree.map(lambda a: a[0], feed.data)
     half = trainer._pooled_dense_half()
     slot_ids = jnp.asarray(trainer.slot_ids)
     sgd_cfg = trainer.engine.config.sgd
-    pooled0 = jax.jit(lambda w: mxu_path.pull_pool_cvm(
-        w, plan, dims, (s, l, b), trainer.use_cvm, interpret=interpret))(ws)
     ins_cvm = jnp.stack([jnp.ones_like(bt["labels"]), bt["labels"]], axis=1)
 
     def timed(body):
@@ -364,34 +371,87 @@ def _profile_step_phases(trainer, feed, k=8):
         float(run())
         return time.perf_counter() - t0
 
+    def timed_ws(body):
+        # push phases MUTATE ws: time them the way the trainer's jitted
+        # step runs them — ws donated and carried through the loop, so
+        # each update is in-place rather than paying a full-[N] working-
+        # set copy per iteration (a scalar-carry closure over ws would
+        # charge that copy to every path and flatten the comparison)
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def run(w):
+            return jax.lax.fori_loop(0, k, lambda i, w: body(w), w)
+        jax.block_until_ready(run(jax.tree.map(jnp.copy, ws)))  # compile
+        w0 = jax.tree.map(jnp.copy, ws)
+        jax.block_until_ready(w0)
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(w0))
+        return time.perf_counter() - t0
+
     floor = timed(lambda c: c + ws["show"][0])
+    floor_w = timed_ws(lambda w: w)
 
     def vary(c):  # cheap data-dependence injection, defeats loop CSE
         return {**ws, "show": ws["show"] + c}
 
-    cross = getattr(trainer, "_mxu_crossing", ("take", "take"))
-    t_pull = timed(lambda c: c + mxu_path.pull_pool_cvm(
-        vary(c), plan, dims, (s, l, b), trainer.use_cvm,
-        interpret=interpret, crossing=cross[0]).sum())
+    # -- fast path (padded-dense baseline): always profiled ---------------
+    fast_pooled0 = jax.jit(lambda w: fast_path.pull_pool_cvm(
+        w, bt["indices"], bt["lengths"], trainer.use_cvm))(ws)
+    t_fast_pull = timed(lambda c: c + fast_path.pull_pool_cvm(
+        vary(c), bt["indices"], bt["lengths"], trainer.use_cvm).sum())
+    t_fast_push = timed_ws(lambda w: fast_path.push_and_update(
+        w, bt["indices"], bt["lengths"], fast_pooled0, ins_cvm,
+        slot_ids, sgd_cfg))
+
+    # -- selected path -----------------------------------------------------
+    out = {"path": path}
+    if path == "fast":
+        pooled0 = fast_pooled0
+        t_pull, t_push = t_fast_pull, t_fast_push
+    elif path == "ragged":
+        plan = plan_tuple(jax.tree.map(lambda a: a[0], feed.plans))
+        pooled0 = jax.jit(lambda w: ragged_path.pull_pool_cvm(
+            w, plan, (s, l, b), trainer.use_cvm))(ws)
+        t_pull = timed(lambda c: c + ragged_path.pull_pool_cvm(
+            vary(c), plan, (s, l, b), trainer.use_cvm).sum())
+        t_push = timed_ws(lambda w: ragged_path.push_and_update(
+            w, plan, pooled0, ins_cvm, (s, l, b), sgd_cfg))
+    else:  # mxu
+        dims = mxu_path.make_dims(s * l * b, n_rows)
+        plan = plan_tuple(jax.tree.map(lambda a: a[0], feed.plans))
+        cross = getattr(trainer, "_mxu_crossing", ("take", "take"))
+        out["crossing"] = f"{cross[0]}/{cross[1]}"
+        pooled0 = jax.jit(lambda w: mxu_path.pull_pool_cvm(
+            w, plan, dims, (s, l, b), trainer.use_cvm,
+            interpret=interpret))(ws)
+        t_pull = timed(lambda c: c + mxu_path.pull_pool_cvm(
+            vary(c), plan, dims, (s, l, b), trainer.use_cvm,
+            interpret=interpret, crossing=cross[0]).sum())
+        t_push = timed_ws(lambda w: mxu_path.push_and_update(
+            w, plan, dims, bt["indices"], pooled0, ins_cvm,
+            slot_ids, sgd_cfg, interpret=interpret, crossing=cross[1]))
 
     def dense_body(c):
-        out = half(trainer.params, trainer.opt_state, trainer.auc_state,
+        res = half(trainer.params, trainer.opt_state, trainer.auc_state,
                    pooled0 + c, bt["dense"], bt["labels"], bt["valid"])
-        return c + out[3]  # loss
+        return c + res[3]  # loss
     t_dense = timed(dense_body)
 
-    def push_body(c):
-        w2 = mxu_path.push_and_update(vary(c), plan, dims, bt["indices"],
-                                      pooled0 + c, ins_cvm, slot_ids,
-                                      sgd_cfg, interpret=interpret,
-                                      crossing=cross[1])
-        return c + w2["show"][0]
-    t_push = timed(push_body)
+    def ms(t, f=None):
+        return round(max(0.0, (t - (floor if f is None else f)) / k * 1e3),
+                     2)
 
-    out = {name: round(max(0.0, (t - floor) / k * 1e3), 2)
-           for name, t in (("pull_pool", t_pull), ("dense_fwd_bwd", t_dense),
-                           ("push_optimizer", t_push))}
-    out["crossing"] = f"{cross[0]}/{cross[1]}"
+    out.update(pull_pool=ms(t_pull), dense_fwd_bwd=ms(t_dense),
+               push_optimizer=ms(t_push, floor_w),
+               fast_pull_pool=ms(t_fast_pull),
+               fast_push_optimizer=ms(t_fast_push, floor_w))
+    sparse = out["pull_pool"] + out["push_optimizer"]
+    total = sparse + out["dense_fwd_bwd"]
+    out["sparse_share"] = round(sparse / total, 4) if total > 0 else 0.0
+    fast_sparse = out["fast_pull_pool"] + out["fast_push_optimizer"]
+    out["ragged_speedup"] = (round(fast_sparse / sparse, 2)
+                             if sparse > 0 else 0.0)
     return out
 
 
@@ -1237,28 +1297,37 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     # amp: bf16 dense compute with f32 master weights (the fleet amp
     # meta-optimizer ≙) — MXU-native precision for the MLP
     amp = os.environ.get("BENCH_AMP", "1") == "1"
+    legacy = os.environ.get("BENCH_LEGACY_FEED") == "1"
+    # sparse step path: ragged (CSR [U]-domain kernels, ROADMAP item 1) is
+    # the default for the pass-resident feed; the legacy streaming feed
+    # can't carry a CSR plan, so it stays on the auto (mxu) resolution
+    sparse_path = os.environ.get("BENCH_SPARSE_PATH",
+                                 "auto" if legacy else "ragged")
     trainer = SparseTrainer(engine, model, dataset.feed_config,
                             batch_size=batch_size, auc_table_size=100_000,
-                            amp=amp)
-    assert trainer._resolve_path() == "mxu", trainer._resolve_path()
+                            amp=amp, sparse_path=sparse_path)
+    resolved = trainer._resolve_path()
+    assert resolved == ("mxu" if sparse_path == "auto" else sparse_path), \
+        resolved
+    record(**{f"{tag}_sparse_path": resolved})
 
     # pass-resident feed: pack + translate + upload + plans at pass-build
     # time (≙ SlotPaddleBoxDataFeed feed-time GPU pack + DedupKeysAndFillIdx,
     # data_feed.cu:1210-1318 / box_wrapper_impl.h:129)
-    legacy = os.environ.get("BENCH_LEGACY_FEED") == "1"
     feed = None
     pack_s = 0.0
     trim_frac = 1.0
     if not legacy:
         t0 = time.perf_counter()
         feed = trainer.build_pass_feed(dataset)
-        jax.block_until_ready(feed.plans["perm"] if feed.plans is not None
-                              else feed.data["indices"])
+        jax.block_until_ready(next(iter(feed.plans.values()))
+                              if feed.plans else feed.data["indices"])
         pack_s = time.perf_counter() - t0
-        if feed.plans is not None:
+        if feed.plans is not None and "rows2d" in feed.plans:
             # kept fraction of the sorted domain after padding-trim
             # (sorted_spmm.trimmed_dims) — the kernel/push-crossing work
             # scales with this; plan_dims holds the untrimmed geometry
+            # (mxu plans only; ragged CSR plans have no trimmed domain)
             trim_frac = (feed.plans["rows2d"].shape[1]
                          / feed.plan_dims.n_chunks)
         record(**{f"{tag}_pass_pack_s": round(pack_s, 1),
@@ -1355,7 +1424,7 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
     step_ms = {}
     if tag == "full" and not legacy \
             and os.environ.get("BENCH_STEP_PROFILE", "1") == "1":
-        set_phase(f"{tag}:step-profile", 300)
+        set_phase(f"{tag}:step-profile", 600)  # two paths profiled
         try:
             step_ms = _profile_step_phases(trainer, feed)
             trace(f"{tag}: step phases {step_ms}")
@@ -1904,6 +1973,15 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
         elif gfrac > threshold:
             regressions.append(
                 f"feed_gap_ratio {go:.2f} -> {gn:.2f} ({gfrac:+.1%})")
+    po = num(old.get("step_ms") or {}, "sparse_share")
+    pn = num(new.get("step_ms") or {}, "sparse_share")
+    if po and pn is not None:           # sparse share creeping back up =
+        pfrac = (pn - po) / po          # the padded-dense regression class
+        out["sparse_share"] = {"old": po, "new": pn,
+                               "delta_frac": round(pfrac, 4)}
+        if pfrac > threshold:
+            regressions.append(
+                f"step_ms.sparse_share {po:.3f} -> {pn:.3f} ({pfrac:+.1%})")
     so = num(old.get("pass_cycle") or {}, "speedup")
     sn = num(new.get("pass_cycle") or {}, "speedup")
     if so and sn is not None:           # lower pipeline speedup = regression

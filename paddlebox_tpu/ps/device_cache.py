@@ -110,6 +110,12 @@ class DeviceRowCache:
     Admission/eviction ranks by the same day-scale score ``shrink`` uses
     (``nonclk_coeff*(show-click) + clk_coeff*click``) plus pass recency;
     rows touched by the current pass are never evicted by it.
+
+    Step-path agnostic: the cache operates on whole working-set rows
+    (gather at adoption, fold-back at end_pass), never on the step's
+    intermediate layout — so fast ([S,L,B] padded), mxu (sorted-chunk),
+    and ragged (CSR [U]-domain) steps compose with it unchanged, and the
+    cache on/off bit-identity tests hold per path.
     """
 
     def __init__(self, capacity: int, nonclk_coeff: float = 0.1,

@@ -31,15 +31,36 @@ import jax.numpy as jnp
 from paddlebox_tpu.config import SparseSGDConfig
 
 
+def step_prelude(idx: jnp.ndarray, lengths: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                            jnp.ndarray]:
+    """Shared per-step mask/flatten prelude: (m, safe_idx, flat, occ).
+
+    pull_pool_cvm and push_and_update both need the length mask (and push
+    its flattened forms); computing it once per step and passing it to
+    both halves saves a [S, L, B] broadcast-compare + where + reshape per
+    step.  Pure function of the batch planes — training-state-free.
+    """
+    S, L, B = idx.shape
+    m = (jnp.arange(L)[None, :, None] < lengths[:, None, :]).astype(
+        jnp.float32)                                       # [S, L, B]
+    safe_idx = jnp.where(m > 0, idx, 0)
+    return m, safe_idx, safe_idx.reshape(-1), m.reshape(-1)
+
+
 def pull_pool_cvm(ws: Dict[str, jnp.ndarray], idx: jnp.ndarray,
-                  lengths: jnp.ndarray, use_cvm: bool = True) -> jnp.ndarray:
+                  lengths: jnp.ndarray, use_cvm: bool = True,
+                  prelude: Optional[Tuple] = None) -> jnp.ndarray:
     """Fused pull + seqpool + CVM.
 
     idx: [S, L, B] pass rows (0 = padding); lengths: [S, B].
     → pooled [B, S, E] with E = 3 + D (cols: cvm'show, cvm'click, w, mf...).
+    prelude: optional step_prelude(idx, lengths) result shared with
+    push_and_update; computed here when absent (back-compat callers).
     """
     S, L, B = idx.shape
-    m = (jnp.arange(L)[None, :, None] < lengths[:, None, :]).astype(
+    m = (prelude[0] if prelude is not None
+         else step_prelude(idx, lengths)[0]).astype(
         ws["show"].dtype)                                  # [S, L, B]
     show = jnp.sum(ws["show"][idx] * m, axis=1)            # [S, B]
     click = jnp.sum(ws["click"][idx] * m, axis=1)
@@ -61,22 +82,21 @@ def pull_pool_cvm(ws: Dict[str, jnp.ndarray], idx: jnp.ndarray,
 def push_and_update(ws: Dict[str, jnp.ndarray], idx: jnp.ndarray,
                     lengths: jnp.ndarray, d_pooled: jnp.ndarray,
                     ins_cvm: jnp.ndarray, slot_ids: jnp.ndarray,
-                    cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
+                    cfg: SparseSGDConfig,
+                    prelude: Optional[Tuple] = None) -> Dict[str, jnp.ndarray]:
     """Merged push + sparse adagrad, batch-domain for the mf table.
 
     idx [S, L, B]; d_pooled [B, S, E] (model grads wrt pull_pool_cvm output
     — cols 0,1 ignored, replaced by ins_cvm per the reference push
-    semantics); ins_cvm [B, 2]; slot_ids [S].
+    semantics); ins_cvm [B, 2]; slot_ids [S]; prelude: optional shared
+    step_prelude(idx, lengths) result (padding occurrences scatter into
+    reserved row 0 via safe_idx).
     """
     S, L, B = idx.shape
     n = ws["show"].shape[0]
     D = ws["mf"].shape[1]
-    m = (jnp.arange(L)[None, :, None] < lengths[:, None, :]).astype(
-        jnp.float32)                                       # [S, L, B]
-    # padding occurrences scatter into reserved row 0
-    safe_idx = jnp.where(m > 0, idx, 0)
-    flat = safe_idx.reshape(-1)                            # [P]
-    occ = m.reshape(-1)
+    m, safe_idx, flat, occ = (prelude if prelude is not None
+                              else step_prelude(idx, lengths))
 
     # -- merged per-row accumulators ([N] scalars; [N, D] once for mf) ----
     g_show = jnp.zeros((n,), jnp.float32).at[flat].add(
@@ -99,29 +119,40 @@ def push_and_update(ws: Dict[str, jnp.ndarray], idx: jnp.ndarray,
         jnp.where(occ > 0, slot_occ, 0))
 
     # -- scalar state: full-table [N] ops (8MB/pass — cheap) --------------
+    # PB301 suppressions below: these 1-D [N] scalar sweeps are this
+    # path's documented contract (module docstring — "per-feature scalars
+    # stay [N] 1-D"); the [U]-domain alternative is ps/ragged_path.py.
     from paddlebox_tpu.ps.optimizer import push_touched
     touched = push_touched(ws, {"g_show": g_show})
+    # pboxlint: disable-next=PB301 -- documented-cheap [N] scalar pass
     show = jnp.where(touched, ws["show"] + g_show, ws["show"])
+    # pboxlint: disable-next=PB301 -- documented-cheap [N] scalar pass
     click = jnp.where(touched, ws["click"] + g_click, ws["click"])
+    # pboxlint: disable-next=PB301 -- documented-cheap [N] scalar pass
     delta = jnp.where(
         touched,
         ws["delta_score"] + cfg.nonclk_coeff * (g_show - g_click)
         + cfg.clk_coeff * g_click,
         ws["delta_score"])
+    # pboxlint: disable-next=PB301 -- documented-cheap [N] scalar pass
     slot = jnp.where(touched, slot_acc, ws["slot"])
     lr_embed = jnp.where(slot == cfg.nodeid_slot, cfg.learning_rate,
                          cfg.feature_learning_rate)
     safe_scale = jnp.where(g_show > 0, g_show, 1.0)
+    # pboxlint: disable-next=PB301 -- documented-cheap [N] scalar pass
     ratio = lr_embed * jnp.sqrt(cfg.initial_g2sum /
                                 (cfg.initial_g2sum + ws["embed_g2sum"]))
     sg = g_embed / safe_scale
+    # pboxlint: disable-next=PB301 -- documented-cheap [N] scalar pass
     embed_w = jnp.where(
         touched,
         jnp.clip(ws["embed_w"] + sg * ratio, cfg.min_bound, cfg.max_bound),
         ws["embed_w"])
+    # pboxlint: disable-next=PB301 -- documented-cheap [N] scalar pass
     embed_g2sum = jnp.where(touched, ws["embed_g2sum"] + sg * sg,
                             ws["embed_g2sum"])
     score = cfg.nonclk_coeff * (show - click) + cfg.clk_coeff * click
+    # pboxlint: disable-next=PB301 -- documented-cheap [N] scalar pass
     create = touched & (ws["mf_size"] == 0) & \
         (score >= cfg.mf_create_thresholds)
     # dynamic per-slot dims (≙ CtrDymfAccessor): created rows record their
@@ -129,6 +160,7 @@ def push_and_update(ws: Dict[str, jnp.ndarray], idx: jnp.ndarray,
     # optimizer rules use — keeps multi-slot keys deterministic)
     from paddlebox_tpu.ps.optimizer import _dym_dims
     dims_row = _dym_dims(cfg, slot, D)
+    # pboxlint: disable-next=PB301 -- documented-cheap [N] scalar pass
     mf_size = jnp.where(create,
                         dims_row if dims_row is not None else D,
                         ws["mf_size"])
@@ -166,8 +198,10 @@ def push_and_update(ws: Dict[str, jnp.ndarray], idx: jnp.ndarray,
            "embed_w": embed_w, "embed_g2sum": embed_g2sum,
            "mf_size": mf_size, "mf_g2sum": mf_g2sum, "mf": mf}
     if "show_acc" in ws:   # ctr_double: exact pass-delta counters
+        # pboxlint: disable-next=PB301 -- documented-cheap [N] scalar pass
         out["show_acc"] = jnp.where(touched, ws["show_acc"] + g_show,
                                     ws["show_acc"])
+        # pboxlint: disable-next=PB301 -- documented-cheap [N] scalar pass
         out["click_acc"] = jnp.where(touched, ws["click_acc"] + g_click,
                                      ws["click_acc"])
     for extra in ("mf_ex", "mf_ex_g2sum"):

@@ -75,9 +75,12 @@ def _pull_table(ws: Dict[str, jnp.ndarray], dims: sp.SpmmDims) -> jnp.ndarray:
     tab = tab.at[0, :n].set(ws["show"])
     tab = tab.at[1, :n].set(ws["click"])
     tab = tab.at[2, :n].set(ws["embed_w"])
+    # pboxlint: disable-next=PB301 -- documented pull-table build cost (one relayout per step, not per-row math)
     tab = tab.at[3:3 + d, :n].set(mf_values(ws, ws["mf"]).T)
     if dx:
+        # pboxlint: disable-next=PB301 -- documented pull-table build cost (one relayout per step, not per-row math)
         tab = tab.at[3 + d:3 + d + dx, :n].set(ws["mf_ex"].T)
+    # pboxlint: disable-next=PB301 -- documented pull-table build cost (one relayout per step, not per-row math)
     tab = tab.at[3 + d + dx, :n].set(ws["mf_size"].astype(jnp.float32))
     return tab
 

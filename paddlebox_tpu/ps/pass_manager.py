@@ -241,6 +241,12 @@ class BoxPSEngine:
         return embedding.PassKeyMapper(uniq), len(uniq), host_rows, plan
 
     def _upload(self, host_rows) -> Dict[str, jnp.ndarray]:
+        # The ws built here is the one contract every step path consumes
+        # — fast's padded [S,L,B] gathers, mxu's sorted chunks, and
+        # ragged's CSR [U]-row gather/scatter all index the same [N]-row
+        # SoA (row 0 reserved zero), so path selection never changes what
+        # begin_pass/end_pass upload or write back.
+        #
         # ctr_double accessor: the host keeps f64 show/click; the device
         # trains in f32, so end_pass writes back host + (device delta) in
         # f64 — counters stay exact past f32's 2^24 integer range

@@ -1,0 +1,151 @@
+"""Ragged CSR sparse step — per-step math in the [P_valid]/[U] domain.
+
+The fast path (ps/fast_path.py) is padded-dense: every pull/push
+materializes [S, L, B] occurrence tensors (≈1.27M cells at bench geometry)
+behind a recomputed length mask, and its scalar-state update runs ~9
+full-[N] elementwise passes over the whole working set per step even
+though only U = |unique(idx)| rows are touched.  This module is the third
+step lowering: the pass is lowered to CSR ONCE host-side
+(data/pass_feed.py build_csr_plans — on the PR 7 prefetch worker the
+build hides under pass N's training), and the jitted step then only ever
+touches
+
+* [P_valid] — the valid (non-padding) occurrences of one batch, and
+* [U]       — the batch's sorted-unique working-set rows,
+
+never the padded [S, L, B] domain and never a full-[N] sweep.  This is
+the Ragged Paged Attention shape (PAPERS.md) applied to the embedding
+step, and COGNATE's keep-sparse-compute-in-the-nonzero-domain argument;
+the reference's fused kernels (pull_box_sparse_op / fused_seqpool_cvm_op)
+do the same work from a pass-scope dedup index (DedupKeysAndFillIdx,
+box_wrapper_impl.h:129).
+
+Plan layout (one batch; see build_csr_plans for the full contract):
+  seg    [P] int32 — pooled segment s*B + b of each valid occurrence
+  inv    [P] int32 — occurrence → [U]-position; position 0 = row 0
+  occ_w  [P] f32   — 1 valid / 0 pad (zeroes pad payloads on push)
+  u_rows [U] int32 — sorted-unique working-set rows (u_rows[0] == 0)
+  u_slot [U] int32 — merged per-row slot id (max over occurrences)
+
+Forward = one [U]-row gather → ``jax.ops.segment_sum`` seqpool → CVM.
+Backward = segment-sum of d_pooled into [U] accumulators → the EXISTING
+optimizer rules (ps/optimizer.py apply_push) applied to the gathered
+[U]-row sub-SoA → one ``.at[u_rows].set`` scatter back.  The optimizer
+rules are shape-generic over their leading dim, and ``push_touched``'s
+``arange(U) != 0`` exclusion lands exactly on [U]-position 0 = reserved
+row 0, so the whole rule set is reused verbatim — no ragged-specific
+update math to keep in sync.
+
+Determinism: segment_sum lowers to a deterministic scatter-add whose
+duplicate contributions apply in operand order; occurrences are
+enumerated in the fast path's canonical (s, l, b) flat order, so per-row
+summand order matches fast_path's own scatter-adds.  The write-back
+scatter has duplicates only at row 0 ([U]-position 0 plus every pad
+position), and all of them carry row 0's untouched pass-through values —
+identical writes, deterministic result.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.config import SparseSGDConfig
+from paddlebox_tpu.ps import optimizer as sparse_opt
+
+# working-set fields the step must NOT route through the [U]-domain
+# gather/update/scatter cycle (quantization sidecars etc. keyed off "mf"
+# stay whatever shape embedding.py gave them; scalars have no row dim)
+_ROW_FIELDS_SKIP = ("mf_scale",)
+
+
+def _row_fields(ws: Dict[str, jnp.ndarray]):
+    n = ws["show"].shape[0]
+    return [f for f, v in ws.items()
+            if f not in _ROW_FIELDS_SKIP
+            and getattr(v, "ndim", 0) >= 1 and v.shape[0] == n]
+
+
+def pull_pool_cvm(ws: Dict[str, jnp.ndarray], plan: Tuple[jnp.ndarray, ...],
+                  shape_slb: Tuple[int, int, int],
+                  use_cvm: bool = True) -> jnp.ndarray:
+    """Fused pull + seqpool + CVM from a CSR plan.
+
+    plan: (seg, inv, occ_w, u_rows, u_slot) — pass_feed.plan_tuple order.
+    → pooled [B, S, E], E = 3 + D (cols: cvm'show, cvm'click, w, mf...) —
+    bit-compatible with fast_path.pull_pool_cvm's output contract.
+
+    Pad occurrences need no mask here: inv = 0 points at [U]-position 0 =
+    working-set row 0, the reserved all-zero row, so their segment
+    contribution is exactly 0.0.
+    """
+    seg, inv, occ_w, u_rows, u_slot = plan
+    s, l, b = shape_slb
+    from paddlebox_tpu.ps.embedding import mf_values
+    head = jnp.stack([ws["show"][u_rows], ws["click"][u_rows],
+                      ws["embed_w"][u_rows]], axis=-1)        # [U, 3]
+    created = (ws["mf_size"][u_rows] > 0).astype(head.dtype)
+    mf_u = mf_values(ws, ws["mf"][u_rows]) * created[:, None]  # [U, D]
+    u_vals = jnp.concatenate([head, mf_u], axis=-1)            # [U, E]
+    pooled = jax.ops.segment_sum(
+        u_vals[inv], seg, num_segments=s * b).reshape(s, b, -1)
+    show = pooled[:, :, 0]
+    click = pooled[:, :, 1]
+    if use_cvm:
+        show_t = jnp.log(show + 1.0)
+        click_t = jnp.log(click + 1.0) - show_t
+    else:
+        show_t, click_t = show, click
+    pooled = jnp.concatenate(
+        [jnp.stack([show_t, click_t], axis=-1), pooled[:, :, 2:]], axis=-1)
+    return jnp.transpose(pooled, (1, 0, 2))                    # [B, S, E]
+
+
+def push_and_update(ws: Dict[str, jnp.ndarray],
+                    plan: Tuple[jnp.ndarray, ...], d_pooled: jnp.ndarray,
+                    ins_cvm: jnp.ndarray, shape_slb: Tuple[int, int, int],
+                    cfg: SparseSGDConfig) -> Dict[str, jnp.ndarray]:
+    """Merged push + optimizer update, entirely in the [P]/[U] domain.
+
+    d_pooled [B, S, E] (cols 0,1 ignored, replaced by ins_cvm per the
+    reference push semantics); ins_cvm [B, 2].  Any OPTIMIZERS rule works:
+    the [U]-row sub-SoA is gathered, apply_push runs verbatim on it, and
+    the result scatters back with one ``.at[u_rows].set`` per field.
+    """
+    seg, inv, occ_w, u_rows, u_slot = plan
+    s, l, b = shape_slb
+    u = u_rows.shape[0]
+    b_of = seg % b
+
+    # -- per-occurrence payloads ([P]) -> merged [U] accumulators ---------
+    # occ_w zeroes every pad position's payload, so pad occurrences add an
+    # exact 0.0 into [U]-position 0 and push_touched never fires there.
+    d_sb = jnp.transpose(d_pooled, (1, 0, 2)).reshape(s * b, -1)  # [S*B, E]
+    occ_pay = jnp.take(d_sb, seg, axis=0)                         # [P, E]
+    g_show = jax.ops.segment_sum(
+        jnp.take(ins_cvm[:, 0], b_of) * occ_w, inv, num_segments=u)
+    g_click = jax.ops.segment_sum(
+        jnp.take(ins_cvm[:, 1], b_of) * occ_w, inv, num_segments=u)
+    g_embed = jax.ops.segment_sum(occ_pay[:, 2] * occ_w, inv,
+                                  num_segments=u)
+    g_mf = jax.ops.segment_sum(occ_pay[:, 3:] * occ_w[:, None], inv,
+                               num_segments=u)                    # [U, D]
+    acc = {"g_show": g_show, "g_click": g_click, "g_embed": g_embed,
+           "g_embedx": g_mf, "slot": u_slot}
+
+    # -- optimizer on the [U]-row frontier only ---------------------------
+    fields = _row_fields(ws)
+    sub = {f: ws[f][u_rows] for f in fields}
+    new = sparse_opt.apply_push(sub, acc, cfg)
+
+    # -- one scatter back into the working-set SoA ------------------------
+    # row 0 appears at [U]-position 0 and at every u_rows pad slot; all of
+    # them were untouched (g_show == 0 there) so every duplicate write
+    # carries row 0's original values — the .set is deterministic.
+    out = dict(ws)
+    for f in fields:
+        if f in new:
+            out[f] = ws[f].at[u_rows].set(new[f])
+    return out

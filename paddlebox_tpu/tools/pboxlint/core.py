@@ -162,12 +162,13 @@ def ALL_CHECKERS():
                                               flight_events, lifecycle,
                                               lockgraph, locks, metric_names,
                                               purity, raceguard, retries,
-                                              serving_path, slo_rules)
+                                              serving_path, slo_rules,
+                                              step_path)
     return (locks.check, flags_hygiene.check, metric_names.check,
             flight_events.check, purity.check, lifecycle.check,
             retries.check, atomic_io.check, device_cache.check,
             lockgraph.check, raceguard.check, slo_rules.check,
-            serving_path.check, cluster_commit.check)
+            serving_path.check, cluster_commit.check, step_path.check)
 
 
 def select_matches(code: str, select: Optional[Sequence[str]]) -> bool:

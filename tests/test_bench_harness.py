@@ -335,6 +335,29 @@ def test_compare_feed_gap_gate_skipped_when_device_idle(bench, monkeypatch,
                          threshold=0.05) == 1
 
 
+def test_compare_flags_sparse_share_regression(bench, monkeypatch, tmp_path):
+    """step_ms.sparse_share creeping back up is the padded-dense
+    regression class the ragged path eliminated — compare gates it."""
+    def rf(path, share):
+        path.write_text(json.dumps(
+            {"metric": "m", "value": 1000.0, "final": True,
+             "step_ms": {"sparse_share": share}}))
+        return str(path)
+
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    assert bench.compare(rf(tmp_path / "o1.json", 0.40),
+                         rf(tmp_path / "n1.json", 0.60),
+                         threshold=0.05) == 1
+    rep = json.loads(out.getvalue())
+    assert any("sparse_share" in r for r in rep["regressions"])
+    assert rep["sparse_share"]["delta_frac"] == pytest.approx(0.5)
+    monkeypatch.setattr(sys, "stdout", io.StringIO())
+    assert bench.compare(rf(tmp_path / "o2.json", 0.40),
+                         rf(tmp_path / "n2.json", 0.41),
+                         threshold=0.05) == 0
+
+
 def test_compare_cli_dispatch(tmp_path):
     import subprocess
     old = _result_file(tmp_path / "old.json", 1000.0, 2.0)

@@ -1592,3 +1592,106 @@ def test_pb605_out_of_scope_module_silent():
                 continue
     """
     assert codes(src, path="paddlebox_tpu/ps/service.py") == []
+
+
+# -- PB301 step-path full-working-set sweeps ---------------------------------
+
+def test_pb301_prefix_push_and_update_full_n_sweeps():
+    """The PRE-FIX ps/fast_path.py push_and_update shape this rule exists
+    for: merged [N] accumulators fed through full-[N] elementwise passes
+    (one per scalar field) inside the jitted per-step function.  Each
+    sweep statement must surface PB301."""
+    src = """
+    import jax.numpy as jnp
+
+    def push_and_update(ws, idx, g_show, g_click, touched, cfg):
+        show = jnp.where(touched, ws["show"] + g_show, ws["show"])
+        click = jnp.where(touched, ws["click"] + g_click, ws["click"])
+        ratio = cfg.lr * jnp.sqrt(
+            cfg.g2 / (cfg.g2 + ws["embed_g2sum"]))
+        create = touched & (ws["mf_size"] == 0)
+        return show, click, ratio, create
+    """
+    assert codes(src, path="paddlebox_tpu/ps/fast_path.py") == ["PB301"] * 4
+
+
+def test_pb301_ragged_gather_update_scatter_clean():
+    """The [U]-domain shape (ps/ragged_path.py): gather the touched rows,
+    do the math on the gathered sub-array, scatter once — plus the
+    structural uses (.shape/.dtype/.at) and bare aliasing.  All allowed."""
+    src = """
+    import jax.numpy as jnp
+
+    def push_and_update(ws, u_rows, g_show):
+        n = ws["show"].shape[0]
+        sub = ws["show"][u_rows] + g_show
+        out = dict(ws)
+        out["show"] = ws["show"].at[u_rows].set(sub)
+        out["mf_scale"] = ws["mf_scale"]
+        mf = jnp.take(ws["mf"], u_rows, axis=0)
+        created = (ws["mf_size"][u_rows] > 0).astype(ws["show"].dtype)
+        return out, mf, created
+    """
+    assert codes(src, path="paddlebox_tpu/ps/ragged_path.py") == []
+
+
+def test_pb301_relayout_set_arg_allowed_wrapped_call_not():
+    """A bare ws[...] fed to a scatter .set() is a relayout copy
+    (mxu_path pull-table build) — allowed; the same array routed through
+    any other call or attribute first is math — flagged."""
+    src = """
+    def _pull_table(ws, tab, n, f):
+        tab = tab.at[0, :n].set(ws["show"])
+        tab = tab.at[1, :n].set(f(ws["click"]))
+        tab = tab.at[2, :n].set(ws["embed_w"].T)
+        return tab
+    """
+    assert codes(src, path="paddlebox_tpu/ps/mxu_path.py") == ["PB301"] * 2
+
+
+def test_pb301_out_of_scope_silent():
+    """Host-side table code legitimately sweeps [N]; the rule only scopes
+    the three step-lowering modules and functions taking ``ws``."""
+    sweep = """
+    import jax.numpy as jnp
+
+    def compact(ws, live):
+        return jnp.where(live, ws["show"] * 0.98, ws["show"])
+    """
+    no_ws = """
+    import jax.numpy as jnp
+
+    def decay(table, live):
+        return jnp.where(live, table["show"] * 0.98, table["show"])
+    """
+    assert codes(sweep, path="paddlebox_tpu/ps/host_table.py") == []
+    assert codes(no_ws, path="paddlebox_tpu/ps/fast_path.py") == []
+
+
+def test_pb301_multiline_statement_single_finding_and_suppression():
+    """A multiline sweep anchors at the statement's first line (one
+    finding, not one per operand) and a disable-next comment there
+    suppresses it."""
+    flagged = """
+    import jax.numpy as jnp
+
+    def step(ws, touched, g):
+        delta = jnp.where(
+            touched,
+            ws["delta_score"] + g,
+            ws["delta_score"])
+        return delta
+    """
+    assert codes(flagged, path="paddlebox_tpu/ps/fast_path.py") == ["PB301"]
+    suppressed = """
+    import jax.numpy as jnp
+
+    def step(ws, touched, g):
+        # pboxlint: disable-next=PB301 -- documented-cheap [N] scalar pass
+        delta = jnp.where(
+            touched,
+            ws["delta_score"] + g,
+            ws["delta_score"])
+        return delta
+    """
+    assert codes(suppressed, path="paddlebox_tpu/ps/fast_path.py") == []
