@@ -59,6 +59,8 @@ BENCH_NO_SUPERVISE=1 (single-process debug mode),
 BENCH_COMPARE_THRESHOLD (default regression threshold for --compare),
 BENCH_CACHE=0 (skip the device-cache on/off compare),
 BENCH_CACHE_PASSES/_KEYS/_DRAWS/_ROWS (cache-compare geometry),
+BENCH_HEAT=0 (skip the heat-telemetry on/off overhead phase),
+BENCH_HEAT_PASSES/_CYCLES/_KEYS/_DRAWS (heat-phase geometry),
 BENCH_SERVING=0 (skip the serving-tier QPS/p99 phase),
 BENCH_SERVING_KEYS/_BATCHES/_BATCH (serving-phase geometry),
 BENCH_CLUSTER=0 (skip the sharded-PS N=1 vs N=4 phase),
@@ -663,6 +665,109 @@ def _cache_compare(tag):
             "hit_rate": on["hit_rate"],
             "wire_bytes_saved": on["wire_bytes_saved"],
             "wire_reduction": round(reduction, 2)}
+
+
+def _heat_bench(tag):
+    """Key-space heat telemetry on/off overhead + gauge snapshot over the
+    real sharded wire path (ISSUE 19).
+
+    Two fresh 2-shard PS fleets drive IDENTICAL zipf-skewed engine pass
+    cycles through a RemoteTableAdapter — remote, because the shard-load
+    attribution tap lives in the client's sharded fan, and a local table
+    would leave ``heat.shard_imbalance`` vacuously zero.  The device row
+    cache is on in BOTH cycles so the hot-coverage tap has admissions to
+    observe and the off/on walls stay like-for-like.  Cycles run
+    interleaved off/on (BENCH_HEAT_CYCLES pairs) and the walls are the
+    per-mode medians — a single 0.3s engine-only cycle is
+    noise-dominated and scheduler drift would otherwise masquerade as
+    tap cost.  tap_ns_per_key is the headline (absolute sketch cost per
+    ingested key, budget 250 ns); overhead_pct is relative to this
+    engine-only cycle (~230 ns/key of useful work) and so reads ~10x
+    worse than what a real train pass with dense compute would pay."""
+    from paddlebox_tpu import flags
+    from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+    from paddlebox_tpu.launch import PSFleet
+    from paddlebox_tpu.ps import heat
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+    from paddlebox_tpu.ps.service import PSClient, RemoteTableAdapter
+    from paddlebox_tpu.utils.monitor import stat_snapshot
+
+    n_passes = int(os.environ.get("BENCH_HEAT_PASSES", 6))
+    n_cycles = int(os.environ.get("BENCH_HEAT_CYCLES", 3))
+    n_keys = int(os.environ.get("BENCH_HEAT_KEYS", 100_000))
+    draws = int(os.environ.get("BENCH_HEAT_DRAWS", 262_144))
+
+    rng = np.random.default_rng(11)
+    blocks = [np.minimum(rng.zipf(1.3, size=draws), n_keys)
+              .astype(np.uint64) for _ in range(n_passes)]
+    tcfg = EmbeddingTableConfig(
+        embedding_dim=8, shard_num=8,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0))
+
+    def cycle(on):
+        flags.set_flags({"obs_heat": bool(on),
+                         "ps_device_cache": True})
+        heat.disable()                  # fresh sketches per cycle
+        flt = PSFleet(2, config=tcfg, seed=0)
+        try:
+            client = PSClient(flt.addrs, deadline=60)
+            engine = BoxPSEngine(tcfg)
+            engine.table = RemoteTableAdapter(client)
+            t0 = None
+            for p in range(n_passes):
+                set_phase(f"{tag}:heat:{'on' if on else 'off'}"
+                          f"[pass {p + 1}/{n_passes}]", 300)
+                engine.begin_feed_pass()
+                engine.add_keys(blocks[p])
+                engine.end_feed_pass()
+                engine.begin_pass()
+                engine.end_pass()
+                if p == 0:
+                    # steady-state wall: pass 1 pays fleet spin-up, first
+                    # connects and row-width learning — whichever cycle
+                    # runs first would absorb process-wide warmup and
+                    # poison the off/on delta
+                    t0 = time.perf_counter()
+            return time.perf_counter() - t0
+        finally:
+            flt.stop()
+
+    prev = {k: flags.get_flags(k) for k in ("obs_heat", "ps_device_cache")}
+    try:
+        cycle(False)    # discarded: process-wide jit + wire-path warmup
+        off_walls, on_walls = [], []
+        for _ in range(max(1, n_cycles)):   # interleaved: drift hits both
+            off_walls.append(cycle(False))
+            on_walls.append(cycle(True))
+        off_wall = sorted(off_walls)[len(off_walls) // 2]
+        on_wall = sorted(on_walls)[len(on_walls) // 2]
+        gauges = stat_snapshot("heat.")
+        hm = heat.ACTIVE
+        sketch_bytes = hm.nbytes() if hm is not None else 0
+    finally:
+        heat.disable()
+        flags.set_flags(prev)
+    overhead = (on_wall - off_wall) / max(off_wall, 1e-9)
+    # absolute tap cost per ingested key — the workload-independent
+    # number.  overhead_pct divides by whatever the off-cycle happens to
+    # cost: this engine-only cycle moves a key end-to-end in ~230 ns, so
+    # ~60 ns/key of sketch taps reads as ~25% here but is <1% of a real
+    # train pass with dense compute behind the same pulls.
+    tap_ns = (on_wall - off_wall) \
+        / max(1, (n_passes - 1) * draws) * 1e9
+    return {"off_wall_s": round(off_wall, 2),
+            "on_wall_s": round(on_wall, 2),
+            "overhead_pct": round(100.0 * overhead, 2),
+            "tap_ns_per_key": round(tap_ns, 1),
+            "topk_share": round(gauges.get("heat.topk_share", 0.0), 4),
+            "shard_imbalance":
+                round(gauges.get("heat.shard_imbalance", 0.0), 4),
+            "cache_hot_coverage":
+                round(gauges.get("heat.cache_hot_coverage", 0.0), 4),
+            "working_set_rows":
+                round(gauges.get("heat.working_set_rows", 0.0), 1),
+            "sketch_bytes": int(sketch_bytes),
+            "passes": n_passes, "zipf_a": 1.3}
 
 
 def _serving_bench(tag):
@@ -1486,6 +1591,28 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
         except Exception as e:  # comparison is diagnostic, never fatal
             trace(f"{tag}: cache-compare failed: {type(e).__name__}: {e}")
 
+    heat_cmp = {}
+    if tag == "full" and not legacy \
+            and os.environ.get("BENCH_HEAT", "1") == "1":
+        set_phase(f"{tag}:heat", 600)
+        try:
+            heat_cmp = _heat_bench(tag)
+            record(heat_tap_ns_per_key=heat_cmp["tap_ns_per_key"],
+                   heat_shard_imbalance=heat_cmp["shard_imbalance"])
+            trace(f"{tag}: heat tap={heat_cmp['tap_ns_per_key']:.0f}ns/key "
+                  f"(wall {heat_cmp['overhead_pct']:+.1f}% of the "
+                  f"engine-only cycle) "
+                  f"topk_share={heat_cmp['topk_share']:.3f} "
+                  f"shard_imbalance={heat_cmp['shard_imbalance']:.2f} "
+                  f"ws_rows={heat_cmp['working_set_rows']:,.0f} "
+                  f"hot_coverage={heat_cmp['cache_hot_coverage']:.3f} "
+                  f"({heat_cmp['sketch_bytes'] / 1e3:.0f} KB sketches)")
+            if heat_cmp["tap_ns_per_key"] > 250.0:
+                trace(f"{tag}: WARNING heat tap cost above the "
+                      "250 ns/key budget")
+        except Exception as e:  # phase is diagnostic, never fatal
+            trace(f"{tag}: heat bench failed: {type(e).__name__}: {e}")
+
     serving = {}
     if tag == "full" and not legacy \
             and os.environ.get("BENCH_SERVING", "1") == "1":
@@ -1563,7 +1690,8 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
 
     return {"e2e": e2e_eps, "device_step": device_eps,
             "pass_cycle": pass_cycle, "recovery": recovery,
-            "cache": cache_cmp, "serving": serving, "cluster": cluster,
+            "cache": cache_cmp, "heat": heat_cmp, "serving": serving,
+            "cluster": cluster,
             "reshard": reshard, "multi_trainer": multi_trainer,
             "batches": int(stats["batches"]), "examples": int(n_examples),
             "auc": round(float(stats.get("auc", float("nan"))), 4),
@@ -1653,7 +1781,7 @@ def run() -> None:
          device_busy_frac=full["device_busy_frac"],
          feed_gap_ratio=full["feed_gap_ratio"],
          pass_cycle=full["pass_cycle"], recovery=full["recovery"],
-         cache=full["cache"], serving=full["serving"],
+         cache=full["cache"], heat=full["heat"], serving=full["serving"],
          cluster=full["cluster"], reshard=full["reshard"],
          multi_trainer=full["multi_trainer"],
          feed_intervals=full["feed_intervals"], timers=full["timers"],
@@ -2009,6 +2137,38 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
             regressions.append(
                 f"cache.wire_reduction {wo:.2f}x -> {wn:.2f}x "
                 f"({wfrac:+.1%})")
+    hto, htn = old.get("heat") or {}, new.get("heat") or {}
+    ovo, ovn = num(hto, "tap_ns_per_key"), num(htn, "tap_ns_per_key")
+    if ovn is not None:                 # heat taps must stay cheap
+        # absolute per-key cost, not a wall percentage: the engine-only
+        # cycle's denominator is ~230 ns/key, so percent-of-wall is
+        # workload-relative noise, while ns/key is what a real train
+        # pass actually pays per pulled key.  Gate: 250 ns/key floor or
+        # +100 ns/key over the old run, whichever is larger.
+        out["heat_tap_ns_per_key"] = {"old": ovo, "new": ovn}
+        if ovn > max(250.0, (ovo or 0.0) + 100.0):
+            regressions.append(
+                f"heat.tap_ns_per_key "
+                f"{ovo if ovo is not None else 0:.0f} -> {ovn:.0f}")
+    pco, pcn = num(hto, "overhead_pct"), num(htn, "overhead_pct")
+    if pcn is not None:                 # relative backstop for the same
+        # signal: the engine-only cycle pays ~10-30% for ~20-60 ns/key
+        # of taps, and single-run medians still wobble ±10 points — only
+        # a catastrophic tap regression clears this band
+        out["heat_overhead_pct"] = {"old": pco, "new": pcn}
+        if pcn > max(50.0, (pco or 0.0) + 25.0):
+            regressions.append(
+                f"heat.overhead_pct "
+                f"{pco if pco is not None else 0:.1f} -> {pcn:.1f}")
+    sio, sin_ = num(hto, "shard_imbalance"), num(htn, "shard_imbalance")
+    if sin_ is not None:                # key placement newly skewing
+        # growth gate with an absolute floor: the workload is fixed, so
+        # a jump means the partition (or a hot-key storm) changed — a
+        # None baseline means the old record predates the phase
+        out["heat_shard_imbalance"] = {"old": sio, "new": sin_}
+        if sio and (sin_ - sio) / sio > threshold and (sin_ - sio) > 0.25:
+            regressions.append(
+                f"heat.shard_imbalance {sio:.2f} -> {sin_:.2f}")
     svo, svn = old.get("serving") or {}, new.get("serving") or {}
     qo, qn = num(svo, "qps"), num(svn, "qps")
     if qo and qn is not None:           # lower serving QPS = regression

@@ -1187,6 +1187,21 @@ def main():
                     help="evaluate the SLO rule set on every timeline "
                          "sample (FLAGS_obs_slo_watchdog; breaches emit "
                          "latched slo_breach flight events).  1 = on")
+    ap.add_argument("--obs_heat", type=int, default=None,
+                    help="key-space heat sketches on every worker "
+                         "(FLAGS_obs_heat; ps/heat.py serves /heatz — "
+                         "hot keys, shard skew, working-set size — and "
+                         "the supervisor's /clusterz merges the fleet "
+                         "view).  1 = on")
+    ap.add_argument("--obs_heat_topk", type=int, default=None,
+                    help="heavy-hitter capacity per heat site "
+                         "(FLAGS_obs_heat_topk)")
+    ap.add_argument("--obs_heat_width", type=int, default=None,
+                    help="count-min sketch width per heat site "
+                         "(FLAGS_obs_heat_width)")
+    ap.add_argument("--obs_heat_depth", type=int, default=None,
+                    help="count-min sketch depth per heat site "
+                         "(FLAGS_obs_heat_depth)")
     ap.add_argument("--ps_servers", type=int, default=0,
                     help="start N supervised PSServer shards in the "
                          "launcher process (one PSServerSupervisor each, "
@@ -1293,6 +1308,18 @@ def main():
     if args.obs_slo_watchdog is not None:
         # pboxlint: disable-next=PB203 -- env export to spawned workers
         os.environ["FLAGS_obs_slo_watchdog"] = str(args.obs_slo_watchdog)
+    if args.obs_heat is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_obs_heat"] = str(args.obs_heat)
+    if args.obs_heat_topk is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_obs_heat_topk"] = str(args.obs_heat_topk)
+    if args.obs_heat_width is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_obs_heat_width"] = str(args.obs_heat_width)
+    if args.obs_heat_depth is not None:
+        # pboxlint: disable-next=PB203 -- env export to spawned workers
+        os.environ["FLAGS_obs_heat_depth"] = str(args.obs_heat_depth)
     if args.auto_resume:
         # pboxlint: disable-next=PB203 -- env export to spawned workers
         os.environ["FLAGS_auto_resume"] = str(args.auto_resume)

@@ -52,6 +52,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from paddlebox_tpu.ps import embedding
+from paddlebox_tpu.ps import heat
 from paddlebox_tpu.utils import flight, lockdep
 from paddlebox_tpu.utils.monitor import stat_add, stat_set
 
@@ -380,6 +381,10 @@ class DeviceRowCache:
                 n_evict = int(np.argmin(wins)) if not wins.all() else k
                 if n_evict:
                     ev = evictable[:n_evict]
+                    if heat.ACTIVE is not None:
+                        # churn tracking: which keys fall out of HBM
+                        heat.ACTIVE.observe("cache_evict",
+                                            self._slot_key[ev])
                     # pboxlint: disable-next=PB102 -- value planes are main-thread-only; _lock guards only the COW index
                     self._slot_key[ev] = 0
                     adm_idx.append(rest[:n_evict])
@@ -421,6 +426,8 @@ class DeviceRowCache:
             self._keys = kocc[korder]
             self._slots = occ[korder]
         stat_set("ps.cache.resident_rows", float(len(occ)))
+        if heat.ACTIVE is not None and len(adm_i):
+            heat.ACTIVE.observe("cache_admit", keys[adm_i])
         if n_evict:
             stat_add("ps.cache.evictions", float(n_evict))
             flight.record("cache_evict", pass_id=pass_id, count=n_evict,

@@ -36,6 +36,7 @@ import numpy as np
 
 from paddlebox_tpu.config import EmbeddingTableConfig
 from paddlebox_tpu.ps import feature_value as fv
+from paddlebox_tpu.ps import heat
 from paddlebox_tpu.utils import lockdep, workpool
 from paddlebox_tpu.utils.monitor import stat_observe
 
@@ -262,6 +263,8 @@ class ShardedHostTable:
         default rows — insertion happens at write-back, matching the
         build-pass flow ps_gpu_wrapper.cc:337-760).  One gather task per
         shard on the pool; tasks write DISJOINT row sets of ``out``."""
+        if heat.ACTIVE is not None:
+            heat.ACTIVE.observe("pull", keys)
         out = fv.default_rows_keyed(keys, self.mf_dim, self._seed,
                                     self.config.sgd.mf_initial_range,
                                     self.config.sgd.initial_range,
@@ -306,6 +309,9 @@ class ShardedHostTable:
         return np.concatenate(parts).astype(np.uint64, copy=False)
 
     def bulk_write(self, keys: np.ndarray, soa: Dict[str, np.ndarray]) -> None:
+        if heat.ACTIVE is not None:
+            heat.ACTIVE.observe("push", keys)
+
         def write_shard(group):
             s, sel = group
             self._shards[s].upsert(keys[sel], fv.select_rows(soa, sel))

@@ -33,6 +33,7 @@ from paddlebox_tpu.config import EmbeddingTableConfig
 from paddlebox_tpu.metrics import quality
 from paddlebox_tpu.parallel.topology import HybridTopology
 from paddlebox_tpu.ps import embedding, faults
+from paddlebox_tpu.ps import heat
 from paddlebox_tpu.ps.device_cache import CachePlan, DeviceRowCache
 from paddlebox_tpu.ps.host_table import ShardedHostTable
 from paddlebox_tpu.utils import flight, intervals, lockdep, trace
@@ -56,6 +57,7 @@ class BoxPSEngine:
                              f"got {mode!r}")
         self.config = config or EmbeddingTableConfig()
         self.topology = topology
+        heat.maybe_enable_from_flags()
         # declared intent, not enforcement: io/checkpoint.py uses it to
         # warn when a serving-only loader (load_xbox) feeds a training
         # engine — the xbox dump cannot round-trip mf_size exactly
@@ -126,6 +128,11 @@ class BoxPSEngine:
             # drain guarantees no feed snapshot is in flight here)
             if self.cache is not None:
                 self.cache.invalidate("end_day")
+            if heat.ACTIVE is not None:
+                # heat is per-process telemetry: every engine fades its
+                # own sketches at its own day boundary (no N-fold
+                # compounding concern — nothing here is shared state)
+                heat.ACTIVE.decay_day()
         self.day_id = date
 
     def flip_phase(self) -> None:
@@ -234,6 +241,8 @@ class BoxPSEngine:
                 pulled_n = len(uniq)
                 if self.cache is not None:
                     stat_add("ps.cache.misses", float(len(uniq)))
+                    if heat.ACTIVE is not None:
+                        heat.ACTIVE.observe_cache(0, len(uniq))
             t1 = time.monotonic()
             intervals.record("pull", t0, t1)
             stat_add("ps.engine.build_pull_s", t1 - t0)
@@ -334,6 +343,10 @@ class BoxPSEngine:
             stat_add("ps.cache.misses", float(n_miss))
             stat_set("ps.cache.hit_rate",
                      n_valid / max(n_valid + n_miss, 1))
+            if heat.ACTIVE is not None:
+                # hot-coverage: share of this pass's pulled rows the
+                # device cache served resident
+                heat.ACTIVE.observe_cache(n_valid, n_miss)
             stat_add("ps.cache.bytes_saved",
                      float(n_valid * self.cache.row_bytes))
         return ws

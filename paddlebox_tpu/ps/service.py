@@ -69,7 +69,7 @@ import numpy as np
 
 from paddlebox_tpu import flags
 from paddlebox_tpu.ps import cluster as ps_cluster
-from paddlebox_tpu.ps import faults, wire
+from paddlebox_tpu.ps import faults, heat, wire
 from paddlebox_tpu.ps.host_table import ShardedHostTable
 from paddlebox_tpu.utils import flight, lockdep, trace
 from paddlebox_tpu.utils.backoff import Backoff
@@ -426,6 +426,7 @@ class PSServer:
             self.tables: Dict[str, ShardedHostTable] = dict(table)
         else:
             self.tables = {DEFAULT_TABLE: table}
+        heat.maybe_enable_from_flags()
         # elastic membership identity: the fleet map this server believes
         # in (None = legacy single-server, never fences) and its own index
         # in it (-1 = not a member — a retiring source after cutover, or a
@@ -1118,6 +1119,11 @@ class PSServer:
                    "tables": ",".join(sorted(self.tables)),
                    "stats": {k: float(v)
                              for k, v in stat_snapshot("ps.").items()}}
+            hs = heat.summary()
+            if hs is not None:
+                # skew pull rides the liveness probe (≙ the stats
+                # sub-dict) even with the obs exporter off
+                out["heat"] = hs
             m, shard, rs = self._membership_view()
             if m is not None:
                 # membership authority surface: clients refresh their
@@ -1428,6 +1434,7 @@ class PSClient:
             addrs = [tuple(addr)]
         self.server_map = ps_cluster.make_server_map(addrs)
         self.n_shards = self.server_map.n
+        heat.maybe_enable_from_flags()
         self.addr = self.server_map.addrs[0]   # back-compat (shard 0)
         # elastic-membership plumbing: callbacks fired after a map
         # refresh adopts a newer epoch (the DeviceRowCache invalidates
@@ -2232,6 +2239,8 @@ class PSClient:
             stat_add(f"ps.cluster.s{shard}.pull_keys", float(len(p)))
             stat_add(f"ps.cluster.s{shard}.est_bytes",
                      float(len(p) * per))
+            if heat.ACTIVE is not None:
+                heat.ACTIVE.observe_shard(shard, len(p))
             reqs = []
             spans = []
             for lo, c in self._chunk_spans(len(p), per):
@@ -2287,6 +2296,8 @@ class PSClient:
                          float(len(p)))
                 stat_add(f"ps.cluster.s{shard}.est_bytes",
                          float(len(p) * per_row))
+                if heat.ACTIVE is not None:
+                    heat.ACTIVE.observe_shard(shard, len(p))
                 sub_rows = {f: np.asarray(v)[p]
                             for f, v in rows.items()}
                 reqs = []
@@ -2433,6 +2444,8 @@ class PSClient:
             stat_add(f"ps.cluster.s{shard}.push_keys", float(len(p)))
             stat_add(f"ps.cluster.s{shard}.est_bytes",
                      float(len(p) * per_row))
+            if heat.ACTIVE is not None:
+                heat.ACTIVE.observe_shard(shard, len(p))
             sub_rows = {f: np.asarray(v)[p] for f, v in rows.items()}
             sub_abs = {f: np.asarray(v)[p] for f, v in rows_abs.items()}
             shard_reqs = []
@@ -2691,6 +2704,23 @@ class PSClient:
                     "stats": stats,
                     "n_shards": self.n_shards,
                     "shards": per}
+            heats = [r.get("heat") for r in per if r.get("heat")]
+            if heats:
+                # cluster heat: shard key spaces are disjoint, so
+                # distinct counts ADD; concentration reads as the
+                # hottest member; imbalance is measured across the
+                # members' observed pull totals
+                totals = [float(h.get("total_keys", 0.0)) for h in heats]
+                mean = sum(totals) / max(len(totals), 1)
+                resp["heat"] = {
+                    "topk_share": max(h.get("topk_share", 0.0)
+                                      for h in heats),
+                    "working_set_rows": round(
+                        sum(h.get("working_set_rows", 0.0)
+                            for h in heats), 1),
+                    "shard_imbalance": round(max(totals) / mean, 4)
+                    if mean > 0 else 0.0,
+                }
         else:
             resp = self._call({"cmd": "health"}, timeout=timeout,
                               deadline=timeout)

@@ -61,6 +61,7 @@ import numpy as np
 from paddlebox_tpu import flags
 from paddlebox_tpu.config import EmbeddingTableConfig
 from paddlebox_tpu.ps import feature_value as fv
+from paddlebox_tpu.ps import heat
 from paddlebox_tpu.ps import wire
 from paddlebox_tpu.ps.host_table import ShardedHostTable
 from paddlebox_tpu.ps.service import DEFAULT_TABLE, PSClient, PSServer
@@ -223,6 +224,7 @@ class ServingReplica(PSServer):
                  seed: int = 0, dedup_state=None):
         self._config = config or EmbeddingTableConfig()
         self._seed = seed
+        heat.maybe_enable_from_flags()
         if tenants is None:
             tenants = [t.strip() for t in
                        str(flags.get_flags("serve_tenants")).split(",")
@@ -368,14 +370,18 @@ class ServingReplica(PSServer):
         g = self._gen
         with self._adm_lock:
             per_tenant = dict(self._tenant_inflight)
-        return {"ok": True, "mode": "serving", "draining": self._draining,
-                "inflight": inflight,
-                "generation": g.generation, "day": g.day,
-                "tenants": ",".join(self.tenants),
-                "tenant_inflight": per_tenant,
-                "tables": ",".join(sorted(g.tables)),
-                "stats": {k: float(v)
-                          for k, v in stat_snapshot("serving.").items()}}
+        out = {"ok": True, "mode": "serving", "draining": self._draining,
+               "inflight": inflight,
+               "generation": g.generation, "day": g.day,
+               "tenants": ",".join(self.tenants),
+               "tenant_inflight": per_tenant,
+               "tables": ",".join(sorted(g.tables)),
+               "stats": {k: float(v)
+                         for k, v in stat_snapshot("serving.").items()}}
+        hs = heat.summary()
+        if hs is not None:
+            out["heat"] = hs
+        return out
 
     def _serve_read(self, req: Dict) -> Dict:
         """THE serving read path — lint rule PB701 proves no
@@ -415,6 +421,8 @@ class ServingReplica(PSServer):
                 return {"ok": True, "generation": g.generation,
                         "tables": {n: t.size()
                                    for n, t in g.tables.items()}}
+            if heat.ACTIVE is not None:
+                heat.ACTIVE.observe(f"serve.{tenant}", req["keys"])
             if cmd == "forward":
                 pooled = self._forward(tab, req["keys"], req["lod"])
                 return {"ok": True, "pooled": pooled,

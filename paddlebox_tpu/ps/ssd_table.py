@@ -21,6 +21,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from paddlebox_tpu.ps import feature_value as fv
+from paddlebox_tpu.ps import heat
 from paddlebox_tpu.utils import lockdep, workpool
 
 _MAGIC = b"PBOXSSD1"
@@ -236,6 +237,9 @@ class SSDTieredTable:
             soa, found = self.shards[si].read_rows(keys[miss])
             hit = miss[found]
             if len(hit):
+                if heat.ACTIVE is not None:
+                    # SSD→DRAM promotions = the live working-set frontier
+                    heat.ACTIVE.observe("fault_in", keys[hit])
                 for f in out:
                     out[f][hit] = soa[f][found]
                 # promote back to DRAM and drop from SSD

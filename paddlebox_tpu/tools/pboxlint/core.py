@@ -159,16 +159,17 @@ def ALL_CHECKERS():
     # local import: checker modules import core for helpers
     from paddlebox_tpu.tools.pboxlint import (atomic_io, cluster_commit,
                                               device_cache, flags_hygiene,
-                                              flight_events, lifecycle,
-                                              lockgraph, locks, metric_names,
-                                              purity, raceguard, retries,
-                                              serving_path, slo_rules,
+                                              flight_events, heat_names,
+                                              lifecycle, lockgraph, locks,
+                                              metric_names, purity, raceguard,
+                                              retries, serving_path, slo_rules,
                                               step_path)
     return (locks.check, flags_hygiene.check, metric_names.check,
             flight_events.check, purity.check, lifecycle.check,
             retries.check, atomic_io.check, device_cache.check,
             lockgraph.check, raceguard.check, slo_rules.check,
-            serving_path.check, cluster_commit.check, step_path.check)
+            serving_path.check, cluster_commit.check, step_path.check,
+            heat_names.check)
 
 
 def select_matches(code: str, select: Optional[Sequence[str]]) -> bool:

@@ -9,7 +9,13 @@
            into one-off buckets and defeats ring triage, or
          * a literal that is not a lowercase identifier
            (``[a-z0-9_]``) — mixed-case/dotted kinds fracture the
-           closed event vocabulary that /flightz filters key on.
+           closed event vocabulary that /flightz filters key on, or
+         * a lowercase literal that is not in :data:`KNOWN_KINDS` — the
+           taxonomy is CLOSED: a new event kind is a deliberate
+           vocabulary change (postmortem tooling, /flightz dashboards
+           and the ``?kind=`` filters all key on it), so it lands by
+           adding the name here in the same change, not by ad-hoc
+           minting at a call site.
 
 Same bounded-field vocabulary as PB204 (``cmd / verb / site / kind /
 role / phase / stage / table``); unbounded values belong in the event's
@@ -33,6 +39,43 @@ from paddlebox_tpu.tools.pboxlint.metric_names import (_BOUNDED_FIELDS,
 
 _KIND_OK = re.compile(r"[a-z0-9_]*\Z")
 _FLIGHT_MOD = "paddlebox_tpu.utils.flight"
+
+# The closed event-kind taxonomy.  Every whole-literal kind passed to
+# flight.record must be one of these; adding an event kind means adding
+# it HERE in the same change (the /flightz ?kind= filters, postmortem
+# groupers and dashboard queries all key on this vocabulary).
+KNOWN_KINDS = frozenset({
+    # pass / day lifecycle
+    "pass_begin", "pass_end", "pass_feed_begin", "pass_feed_end",
+    "day_end", "prefetch_pass_ready", "prefetch_pass_failed",
+    # checkpoint / commit
+    "checkpoint_save", "checkpoint_load", "ckpt_commit", "ckpt_gc",
+    "membership_commit",
+    # device row cache
+    "cache_evict", "cache_invalidate", "cache_invalidate_moved",
+    "cache_invalidate_shard",
+    # wire / verbs / dedup
+    "verb_retry", "verb_give_up", "fence_redirect", "stream_reconnect",
+    "dedup_hit", "dedup_evict", "dedup_restore", "map_refresh",
+    "backoff_sleep", "backoff_exhausted",
+    # reshard / elastic fleet
+    "reshard_begin", "reshard_drive", "reshard_cutover", "reshard_abort",
+    "reshard_done", "ps_fleet_resize", "elastic_grow", "elastic_scale_in",
+    "elastic_rerendezvous", "leader_elect", "fleet_cursor",
+    # trainer / supervisor lifecycle
+    "trainer_resume", "trainer_restart", "worker_restart",
+    "resume_begin", "resume_ok", "supervisor_give_up",
+    # serving tier
+    "serving_load", "serving_swap", "serving_resurrect",
+    "serving_failover",
+    # diagnostics
+    "fault_injected", "lock_cycle", "race_suspect", "pool_saturated",
+    "postmortem_written", "slo_breach", "slo_clear",
+    # key-space heat telemetry (ps/heat.py)
+    "heat_snapshot", "heat_imbalance",
+    # out-of-package emitters sharing the ring (bench.py)
+    "bench_phase",
+})
 
 
 def _record_sinks(mod: Module) -> Set[str]:
@@ -71,6 +114,10 @@ def _findings_for_kind(mod: Module, call: ast.Call,
     if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
         if not _KIND_OK.match(arg.value):
             flag(f"literal {arg.value!r} is not a lowercase identifier")
+        elif arg.value not in KNOWN_KINDS:
+            flag(f"literal {arg.value!r} is not in the closed KNOWN_KINDS "
+                 f"taxonomy (tools/pboxlint/flight_events.py) — new event "
+                 f"kinds are added there in the same change")
         return out
     if isinstance(arg, ast.JoinedStr):
         for part in arg.values:

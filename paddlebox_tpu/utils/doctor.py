@@ -111,6 +111,13 @@ def dump_state(reason: str = "", flight_n: int = _FLIGHT_N) -> Dict:
                                   "tail": timeline.tail()}
     except Exception as e:
         bundle["timeline"] = {"error": repr(e)}
+    try:                            # lazy: utils never imports ps eagerly
+        from paddlebox_tpu.ps import heat
+        if heat.ACTIVE is not None:
+            # the key-space heat tail: was the wedge a hot-key storm?
+            bundle["heat"] = heat.ACTIVE.render(topn=20)
+    except Exception as e:
+        bundle["heat"] = {"error": repr(e)}
     return bundle
 
 

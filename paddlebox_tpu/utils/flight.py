@@ -80,11 +80,15 @@ class FlightRecorder:
 
     def events(self, n: Optional[int] = None,
                kind: Optional[str] = None) -> List[Dict]:
-        """Newest-first events, optionally filtered by kind."""
+        """Newest-first events, optionally filtered by kind —
+        ``kind`` accepts one name or a comma-separated list
+        (``"slo_breach,slo_clear"``; blanks ignored)."""
         with self._lock:
             out = [dict(e) for e in reversed(self._ring)]
         if kind:
-            out = [e for e in out if e["kind"] == kind]
+            want = {k.strip() for k in kind.split(",") if k.strip()}
+            if want:
+                out = [e for e in out if e["kind"] in want]
         return out if n is None else out[:max(0, int(n))]
 
     def counts(self) -> Dict[str, int]:

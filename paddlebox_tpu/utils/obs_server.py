@@ -96,21 +96,38 @@ def render_prometheus(prefix: str = "") -> str:
 
 # reserved key carrying raw histogram buckets in a /statz?raw=1 snapshot
 HIST_RAW_KEY = "_hist_raw"
+# reserved key carrying the mergeable heat-sketch export (ps/heat.py's
+# raw() in the utils/sketch.py merge_heat_raw schema)
+HEAT_RAW_KEY = "_heat_raw"
+
+
+def _heat_active():
+    """The process HeatMap, or None.  Lazy: utils must not import ps at
+    module level (doctor.py's embed discipline)."""
+    try:
+        from paddlebox_tpu.ps import heat
+    except Exception:  # noqa: BLE001 — obs must not require the ps layer
+        return None
+    return heat.ACTIVE
 
 
 def render_statz(raw: bool = False, prefix: str = "") -> str:
     """The flat JSON snapshot.  Non-finite gauges are OMITTED — bare
     ``Infinity``/``NaN`` tokens are invalid JSON and would break every
     strict consumer of the scrape.  ``raw=True`` adds ``_hist_raw``
-    (sparse bucket counts per histogram) for bucket-wise supervisor
-    merging; ``prefix`` narrows both to one dotted subtree so the
-    cluster scraper (and external Prometheus) can pull slices instead
-    of the full snapshot every interval."""
+    (sparse bucket counts per histogram) and, when heat telemetry is on,
+    ``_heat_raw`` (the mergeable key-space sketch export) for bucket-wise
+    supervisor merging; ``prefix`` narrows the stat keys to one dotted
+    subtree so the cluster scraper (and external Prometheus) can pull
+    slices instead of the full snapshot every interval."""
     reg = StatRegistry.instance()
     out: Dict = {k: v for k, v in reg.snapshot(prefix).items()
                  if math.isfinite(v)}
     if raw:
         out[HIST_RAW_KEY] = reg.hist_raw(prefix)
+        hm = _heat_active()
+        if hm is not None:
+            out[HEAT_RAW_KEY] = hm.raw()
     return json.dumps(out, sort_keys=True)
 
 
@@ -128,6 +145,19 @@ def render_flightz(n: int = 256, kind: Optional[str] = None) -> str:
         "counts": ring.counts() if ring is not None else {},
         "events": flight.events(n=n, kind=kind),
     }, default=str)
+
+
+def render_heatz(topn: int = 100) -> str:
+    """The key-space heat plane (ps/heat.py): per-site top-K keys with
+    estimated rates, per-shard load shares, the fitted zipf exponent and
+    the working-set curve.  ``enabled=False`` when FLAGS_obs_heat is off
+    (or the ps layer isn't importable)."""
+    hm = _heat_active()
+    if hm is None:
+        return json.dumps({"enabled": False})
+    out = hm.render(topn=topn)
+    out["enabled"] = True
+    return json.dumps(out)
 
 
 def render_timelinez(name: Optional[str] = None,
@@ -195,6 +225,9 @@ class _Handler(BaseHTTPRequestHandler):
                 kind = q.get("kind", [None])[0]
                 body, ctype = render_flightz(n=n, kind=kind), \
                     "application/json"
+            elif path == "/heatz":
+                topn = int(q.get("topn", ["100"])[0])
+                body, ctype = render_heatz(topn=topn), "application/json"
             elif path == "/timelinez":
                 name = q.get("name", [None])[0]
                 n_s = q.get("n", [None])[0]
@@ -212,7 +245,8 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self.send_error(404, "unknown path (want /metrics, "
                                      "/statz, /tracez, /flightz, "
-                                     "/timelinez, /clusterz, /debugz)")
+                                     "/heatz, /timelinez, /clusterz, "
+                                     "/debugz)")
                 return
         except Exception as e:  # noqa: BLE001 — a scrape must never kill
             self.send_error(500, repr(e))
@@ -271,6 +305,11 @@ def maybe_start_from_flags() -> Optional[ObsServer]:
     ``FLAGS_obs_trace`` for the tracer alone."""
     trace.maybe_enable_from_flags()
     timeline.maybe_start_from_flags()
+    try:
+        from paddlebox_tpu.ps import heat
+        heat.maybe_enable_from_flags()
+    except Exception:  # noqa: BLE001 — obs must not require the ps layer
+        pass
     port = int(flags.get_flags("obs_port"))
     if port <= 0:
         return None
@@ -310,6 +349,7 @@ def merge_snapshots(snaps: List[Dict[str, float]]) -> Dict[str, float]:
     old max-of-percentiles fallback, so merged tails never understate."""
     out: Dict[str, float] = {}
     raws: Dict[str, List[Dict]] = {}
+    heat_raws: List[Dict] = []
     for snap in snaps:
         if not snap:
             continue
@@ -318,8 +358,19 @@ def merge_snapshots(snaps: List[Dict[str, float]]) -> Dict[str, float]:
         for name, r in hr.items():
             if isinstance(r, dict):
                 raws.setdefault(name, []).append(r)
+        heat_r = snap.get(HEAT_RAW_KEY)
+        if isinstance(heat_r, dict):
+            heat_raws.append(heat_r)
         for k, v in snap.items():
             if k == HIST_RAW_KEY or not isinstance(v, (int, float)):
+                continue
+            if k.startswith("heat."):
+                # heat gauges are sketch-derived, not additive: summing
+                # topk_share across workers is meaningless.  Raw-scraped
+                # workers are recomputed from the merged sketches below;
+                # max is the non-raw fallback (never understates skew)
+                if v > out.get(k, float("-inf")):
+                    out[k] = v
                 continue
             if k.endswith(_MERGE_MAX_SUFFIXES):
                 # this worker's percentile keys are recomputed from its
@@ -338,4 +389,11 @@ def merge_snapshots(snaps: List[Dict[str, float]]) -> Dict[str, float]:
             k = name + suf
             v = h.percentile(q)
             out[k] = max(out[k], v) if k in out else v
+    if heat_raws:
+        # fleet heat = bucket-wise sketch merge, then the SAME derived-
+        # gauge formula every worker applies locally — never a naive
+        # fold of the workers' gauges
+        from paddlebox_tpu.utils import sketch
+        out.update(sketch.heat_gauges_from_raw(
+            sketch.merge_heat_raw(heat_raws)))
     return out

@@ -71,6 +71,11 @@ flags.define_flag(
     "serving-tier SLO: per-tenant pull p99 latency budget in ms "
     "(serving_rules breaches when serving.<tenant>.latency_s.p99 stays "
     "over this for the rule window)")
+flags.define_flag(
+    "obs_slo_heat_imbalance", 4.0,
+    "SLO watchdog threshold for PS shard skew: breach when "
+    "heat.shard_imbalance (max/mean shard key load, ps/heat.py) stays "
+    "over this for the rule window — read /heatz before resize(new_n)")
 
 # Keys carrying level/percentile semantics: retained as value series but
 # excluded from rate derivation (a gauge moving down is not a counter
@@ -78,7 +83,7 @@ flags.define_flag(
 _GAUGE_SUFFIXES = (".p50", ".p95", ".p99", ".max", "hwm", "_frac",
                    "_ratio", "_rate", "_gen", "generation", ".threads",
                    "resident_rows")
-_GAUGE_PREFIXES = ("quality.",)
+_GAUGE_PREFIXES = ("quality.", "heat.")
 
 
 def is_gauge_key(key: str) -> bool:
@@ -315,6 +320,12 @@ def default_rules() -> List[SloRule]:
                 kind="drop", threshold=auc_eps,
                 window_s=600.0, min_samples=2,
                 reason="pass AUC fell below its recent-window maximum"),
+        SloRule("heat_shard_imbalance", "heat.shard_imbalance",
+                kind="gauge", op="gt",
+                threshold=float(flags.get_flags("obs_slo_heat_imbalance")),
+                window_s=30.0, min_samples=3,
+                reason="PS shard key load skewed far off the mean — "
+                       "a hot shard is serializing the pull fan"),
     ]
 
 

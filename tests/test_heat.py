@@ -1,0 +1,668 @@
+"""Key-space heat telemetry (ISSUE 19): sketch accuracy on seeded zipf
+streams vs exact counts (count-min never undercounts, SpaceSaving
+top-100 recall >= 0.9, HLL within its error band), merge associativity
+(fleet heat == per-worker sketch merge, never a naive max fold),
+decay_day semantics, the /heatz + /clusterz HTTP round-trips, the
+heat_imbalance latch + heat_shard_imbalance SLO rule, health-verb heat
+sub-dicts, the /flightz comma-kind filter, and the contract that
+matters most: FLAGS_obs_heat changes TELEMETRY ONLY — training is
+bit-identical to heat-off, serial, prefetched, and under seeded PS
+connection chaos."""
+
+import json
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import flags
+from paddlebox_tpu.config import (DataFeedConfig, EmbeddingTableConfig,
+                                  SlotConfig, SparseSGDConfig)
+from paddlebox_tpu.data.dataset import SlotDataset
+from paddlebox_tpu.data.prefetch import PassPrefetcher
+from paddlebox_tpu.data.slot_record import SlotRecordBlock
+from paddlebox_tpu.launch import ClusterScraper
+from paddlebox_tpu.models.deepfm import DeepFM
+from paddlebox_tpu.ps import heat
+from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+from paddlebox_tpu.trainer.trainer import SparseTrainer
+from paddlebox_tpu.utils import flight, obs_server, sketch, timeline
+from paddlebox_tpu.utils.monitor import StatRegistry, stat_get
+
+CAP = 3
+N_DAYS, N_PASSES, B = 2, 3, 32
+MB4 = 4 * 1024 * 1024
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    prev = {k: flags.get_flags(k)
+            for k in ("obs_heat", "obs_heat_topk", "obs_heat_width",
+                      "obs_heat_depth", "obs_heat_decay")}
+    StatRegistry.instance().reset()
+    heat.disable()
+    fr = flight.ring()
+    if fr is not None:
+        fr.clear()
+    yield
+    heat.disable()
+    fr = flight.ring()
+    if fr is not None:
+        fr.clear()
+    flags.set_flags(prev)
+
+
+def _zipf_stream(n=200_000, a=1.3, cap=100_000, seed=7):
+    rng = np.random.default_rng(seed)
+    return np.minimum(rng.zipf(a, size=n), cap).astype(np.uint64)
+
+
+def _exact_counts(stream):
+    uniq, counts = np.unique(stream, return_counts=True)
+    return dict(zip(uniq.tolist(), counts.astype(float).tolist()))
+
+
+def _exact_topn(stream, n=100):
+    exact = _exact_counts(stream)
+    return {k for k, _ in sorted(exact.items(),
+                                 key=lambda kv: -kv[1])[:n]}
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as r:
+        return r.read().decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# Sketch accuracy vs exact on a seeded zipf-1.3 stream (default sizes).
+# ---------------------------------------------------------------------------
+
+def test_countmin_never_undercounts_and_honors_bound():
+    stream = _zipf_stream()
+    cm = sketch.CountMinSketch()                 # 2048x4, the default
+    for chunk in np.array_split(stream, 16):
+        cm.update(*sketch.unique_with_counts(chunk))
+    exact = _exact_counts(stream)
+    keys = np.fromiter(exact, np.uint64)
+    est = cm.estimate(keys)
+    truth = np.array([exact[int(k)] for k in keys])
+    over = est - truth
+    assert (over >= -1e-9).all(), "count-min undercounted"
+    # eps*N is the w.p. 1-e^-depth per-query bound; on this stream the
+    # max overshoot must clear it outright
+    assert over.max() <= cm.epsilon() * len(stream)
+    assert cm.total == pytest.approx(len(stream))
+
+
+def test_spacesaving_top100_recall_and_error_bound():
+    stream = _zipf_stream()
+    ss = sketch.SpaceSaving(k=512)               # the default capacity
+    for chunk in np.array_split(stream, 16):
+        ss.update(*sketch.unique_with_counts(chunk))
+    exact = _exact_counts(stream)
+    top = ss.top(100)
+    got = {k for k, _, _ in top}
+    recall = len(got & _exact_topn(stream, 100)) / 100
+    assert recall >= 0.9, f"top-100 recall {recall:.2f}"
+    # per-entry bound: est - err <= exact <= est, err <= N/k
+    for key, est, err in top:
+        true = exact.get(key, 0.0)
+        assert est + 1e-9 >= true >= est - err - 1e-9
+        assert err <= len(stream) / 512 + 1e-9
+    assert 0.0 < ss.topk_share(100) <= 1.0
+
+
+def test_hll_distinct_within_error_band():
+    stream = _zipf_stream()
+    hll = sketch.HyperLogLog()                   # p=12, ~1.6% std error
+    for chunk in np.array_split(stream, 16):
+        hll.update(np.unique(chunk))
+    exact = len(_exact_counts(stream))
+    assert abs(hll.estimate() - exact) / exact <= 0.05
+
+
+def test_fit_zipf_exponent_recovers_stream_skew():
+    stream = _zipf_stream()
+    counts = sorted(_exact_counts(stream).values(), reverse=True)[:200]
+    assert sketch.fit_zipf_exponent(counts) == pytest.approx(1.3, abs=0.2)
+
+
+def test_shardload_imbalance_math():
+    sl = sketch.ShardLoad()
+    for s in range(4):
+        sl.add(s, 100.0)
+    assert sl.imbalance() == pytest.approx(1.0)  # even
+    sl.add(0, 300.0)                             # 400/100/100/100
+    assert sl.imbalance() == pytest.approx(400.0 / 175.0)
+    assert sl.shares() == pytest.approx([4 / 7, 1 / 7, 1 / 7, 1 / 7])
+    assert sketch.ShardLoad().imbalance() == 0.0  # no traffic
+
+
+# ---------------------------------------------------------------------------
+# Merge: split-stream == full-stream, associative, raw round-trip.
+# ---------------------------------------------------------------------------
+
+def test_merge_equals_full_stream_and_is_associative():
+    stream = _zipf_stream()
+    parts = np.array_split(stream, 3)
+    keys = np.fromiter(_exact_counts(stream), np.uint64)
+
+    def cm_of(part):
+        c = sketch.CountMinSketch()
+        c.update(*sketch.unique_with_counts(part))
+        return c
+
+    full = cm_of(stream)
+    a, b, c = (cm_of(p) for p in parts)
+    ab_c = cm_of(parts[0])                       # (a+b)+c
+    ab_c.merge(b)
+    ab_c.merge(c)
+    a_bc = cm_of(parts[1])                       # a+(b+c)
+    a_bc.merge(c)
+    a_bc.merge(cm_of(parts[0]))
+    # count-min merge is matrix addition: EXACTLY the full-stream sketch
+    np.testing.assert_allclose(ab_c.estimate(keys), full.estimate(keys))
+    np.testing.assert_allclose(a_bc.estimate(keys), full.estimate(keys))
+    assert ab_c.total == pytest.approx(full.total)
+
+    # HLL merge is register-max: exactly the full-stream registers
+    hlls = []
+    for p in parts:
+        h = sketch.HyperLogLog()
+        h.update(np.unique(p))
+        hlls.append(h)
+    merged = sketch.HyperLogLog()
+    for h in hlls:
+        merged.merge(h)
+    fullh = sketch.HyperLogLog()
+    fullh.update(np.unique(stream))
+    assert merged.raw() == fullh.raw()
+
+    # SpaceSaving merge keeps the heavy hitters within the summed bound
+    sss = []
+    for p in parts:
+        s = sketch.SpaceSaving(k=512)
+        s.update(*sketch.unique_with_counts(p))
+        sss.append(s)
+    ms = sketch.SpaceSaving.from_raw([s.raw() for s in sss])
+    exact = _exact_counts(stream)
+    got = {k for k, _, _ in ms.top(100)}
+    assert len(got & _exact_topn(stream, 100)) / 100 >= 0.9
+    for key, est, err in ms.top(100):
+        assert est + 1e-6 >= exact.get(key, 0.0) >= est - err - 1e-6
+
+
+def test_merge_heat_raw_gauges_are_sketch_merge_not_gauge_fold():
+    # two workers with DISJOINT hot key ranges: the fleet working set is
+    # their UNION — a max (or sum) of the workers' own gauges cannot
+    # produce it; only the register-level merge can
+    hm1, hm2 = heat.HeatMap(), heat.HeatMap()
+    hm1.observe("pull", np.arange(0, 3000, dtype=np.uint64))
+    hm2.observe("pull", np.arange(50_000, 53_000, dtype=np.uint64))
+    hm1.observe_shard(0, 100)
+    hm1.observe_shard(1, 100)
+    hm2.observe_shard(0, 700)
+    hm2.observe_shard(1, 100)
+    raw1, raw2 = hm1.raw(), hm2.raw()
+    g = sketch.heat_gauges_from_raw(sketch.merge_heat_raw([raw1, raw2]))
+    solo = max(sketch.heat_gauges_from_raw(raw1)["heat.working_set_rows"],
+               sketch.heat_gauges_from_raw(raw2)["heat.working_set_rows"])
+    assert g["heat.working_set_rows"] > 1.5 * solo
+    # loads add element-wise: 800/200 across both workers -> 1.6
+    assert g["heat.shard_imbalance"] == pytest.approx(1.6)
+
+
+# ---------------------------------------------------------------------------
+# HeatMap: gauges, memory budget, day-boundary decay, imbalance latch.
+# ---------------------------------------------------------------------------
+
+def test_heatmap_publishes_gauges_within_memory_budget():
+    hm = heat.enable()
+    stream = _zipf_stream(n=50_000)
+    for chunk in np.array_split(stream, 8):
+        hm.observe("pull", chunk)
+    hm.observe_shard(0, 3000)
+    hm.observe_shard(1, 1000)
+    hm.observe_cache(70, 30)
+    assert 0.0 < stat_get("heat.topk_share") <= 1.0
+    exact_ws = len(_exact_counts(stream))
+    assert stat_get("heat.working_set_rows") == \
+        pytest.approx(exact_ws, rel=0.05)
+    assert stat_get("heat.shard_imbalance") == pytest.approx(1.5)
+    assert stat_get("heat.cache_hot_coverage") == pytest.approx(0.7)
+    assert hm.nbytes() <= MB4
+    s = hm.summary()
+    assert set(s) == {"topk_share", "shard_imbalance",
+                      "working_set_rows", "total_keys"}
+
+
+def test_site_cap_bounds_memory_against_hostile_site_names():
+    hm = heat.HeatMap()
+    for i in range(heat._MAX_SITES * 2):
+        hm.observe(f"serve.t{i}", np.arange(5, dtype=np.uint64))
+    assert len(hm.raw()["sites"]) == heat._MAX_SITES
+
+
+def test_decay_day_fades_frequencies_and_resets_working_set():
+    hm = heat.enable()
+    hm.observe("pull", _zipf_stream(n=20_000))
+    total0 = hm.summary()["total_keys"]
+    ws0 = hm.summary()["working_set_rows"]
+    assert total0 > 0 and ws0 > 0
+    hm.decay_day()                               # default factor 0.5
+    s = hm.summary()
+    assert s["total_keys"] == pytest.approx(total0 * 0.5, rel=1e-6)
+    assert s["working_set_rows"] == 0.0          # HLL resets, not decays
+    snaps = flight.events(kind="heat_snapshot")
+    assert len(snaps) == 1
+    hm.decay_day(factor=0.0)                     # explicit full fade
+    assert hm.summary()["total_keys"] == 0.0
+    assert len(flight.events(kind="heat_snapshot")) == 2
+
+
+def test_heat_imbalance_event_latches_and_rearms():
+    # max/mean tops out at n_shards, so skew needs a real fleet: 8
+    # shards, all the traffic landing on shard 0
+    hm = heat.enable()
+    for s in range(8):
+        hm.observe_shard(s, 100)
+    assert flight.events(kind="heat_imbalance") == []
+    for _ in range(10):                          # collapse: one event
+        hm.observe_shard(0, 10_000)
+    evs = flight.events(kind="heat_imbalance")
+    assert len(evs) == 1 and evs[0]["imbalance"] >= 4.0
+    for s in range(1, 8):                        # recovery unlatches
+        hm.observe_shard(s, 20_000)
+    assert stat_get("heat.shard_imbalance") < 4.0
+    assert len(flight.events(kind="heat_imbalance")) == 1
+    hm.observe_shard(0, 1_000_000)               # second collapse re-fires
+    assert len(flight.events(kind="heat_imbalance")) == 2
+
+
+# ---------------------------------------------------------------------------
+# /heatz + /statz?raw=1 + /clusterz: the HTTP export plane.
+# ---------------------------------------------------------------------------
+
+def test_heatz_round_trip_zipf_recall_and_budget():
+    """The acceptance bar verbatim: on a zipf-1.3 run /heatz reports
+    top-100 recall >= 0.9 vs exact with <= 4 MB sketch memory."""
+    flags.set_flags({"obs_heat": True})
+    hm = heat.enable()
+    stream = _zipf_stream()
+    for chunk in np.array_split(stream, 20):
+        hm.observe("pull", chunk)
+    srv = obs_server.ObsServer(port=0)
+    try:
+        body = json.loads(_get(srv.addr[1], "/heatz"))
+        assert body["enabled"] is True
+        pull = body["sites"]["pull"]
+        got = {int(e["key"]) for e in pull["top"]}
+        assert len(got & _exact_topn(stream, 100)) / 100 >= 0.9
+        assert body["sketch_bytes"] <= MB4
+        assert pull["zipf_exponent"] == pytest.approx(1.3, abs=0.2)
+        assert pull["share_curve"][-1]["share"] <= 1.0
+        assert all(e["est_rate_hz"] > 0 for e in pull["top"])
+        small = json.loads(_get(srv.addr[1], "/heatz?topn=5"))
+        assert len(small["sites"]["pull"]["top"]) == 5
+        # raw statz carries the mergeable export for the supervisor
+        snap = json.loads(_get(srv.addr[1], "/statz?raw=1"))
+        assert "pull" in snap[obs_server.HEAT_RAW_KEY]["sites"]
+    finally:
+        srv.shutdown()
+
+
+def test_heatz_disabled_when_heat_off():
+    srv = obs_server.ObsServer(port=0)
+    try:
+        assert json.loads(_get(srv.addr[1], "/heatz")) == \
+            {"enabled": False}
+    finally:
+        srv.shutdown()
+
+
+def test_flightz_kind_filter_accepts_comma_list():
+    flight.record("heat_snapshot", topk_share=0.5)
+    flight.record("heat_imbalance", imbalance=5.0)
+    flight.record("pass_begin", pass_id=0)
+    got = flight.events(kind="heat_snapshot,heat_imbalance")
+    assert {e["kind"] for e in got} == \
+        {"heat_snapshot", "heat_imbalance"} and len(got) == 2
+    assert len(flight.events(kind="pass_begin")) == 1
+    srv = obs_server.ObsServer(port=0)
+    try:
+        body = json.loads(_get(
+            srv.addr[1], "/flightz?kind=heat_snapshot,heat_imbalance"))
+        assert {e["kind"] for e in body["events"]} == \
+            {"heat_snapshot", "heat_imbalance"}
+    finally:
+        srv.shutdown()
+
+
+def test_cluster_scraper_merged_heat_equals_per_worker_sketch_merge():
+    """ClusterScraper's fleet gauges must equal merging the workers' raw
+    sketches then applying the per-worker gauge formula — pinned against
+    stubbed workers with disjoint key ranges, where a naive max (or sum)
+    of the workers' own gauges gives a different answer."""
+    hm1, hm2 = heat.HeatMap(), heat.HeatMap()
+    hm1.observe("pull", np.arange(0, 4000, dtype=np.uint64))
+    hm2.observe("pull", np.arange(80_000, 84_000, dtype=np.uint64))
+    hm1.observe_shard(0, 900)
+    hm2.observe_shard(1, 100)
+    raw1, raw2 = hm1.raw(), hm2.raw()
+    snaps = {7001: {"w.ops": 1.0, obs_server.HEAT_RAW_KEY: raw1},
+             7002: {"w.ops": 2.0, obs_server.HEAT_RAW_KEY: raw2}}
+    scraper = ClusterScraper([7001, 7002], interval_s=600.0)
+    real = scraper._obs
+    scraper._obs = types.SimpleNamespace(
+        scrape=lambda port, **kw: dict(snaps[port]),
+        merge_snapshots=real.merge_snapshots,
+        set_clusterz_provider=real.set_clusterz_provider)
+    assert scraper.scrape_once() == 2
+    latest = scraper.ring.samples()[-1]["stats"]
+    want = sketch.heat_gauges_from_raw(
+        sketch.merge_heat_raw([raw1, raw2]))
+    for k, v in want.items():
+        assert latest[k] == pytest.approx(v), k
+    solo = max(
+        sketch.heat_gauges_from_raw(raw1)["heat.working_set_rows"],
+        sketch.heat_gauges_from_raw(raw2)["heat.working_set_rows"])
+    assert latest["heat.working_set_rows"] > 1.5 * solo
+    assert latest["w.ops"] == 3.0                # counters still sum
+
+
+def test_clusterz_carries_fleet_heat_over_http():
+    flags.set_flags({"obs_heat": True})
+    hm = heat.enable()
+    hm.observe("pull", _zipf_stream(n=20_000))
+    hm.observe_shard(0, 500)
+    hm.observe_shard(1, 100)
+    srv = obs_server.ObsServer(port=0)
+    try:
+        scraper = ClusterScraper([srv.addr[1]], interval_s=600.0)
+        obs_server.set_clusterz_provider(scraper.render)
+        assert scraper.scrape_once() == 1
+        idx = json.loads(_get(srv.addr[1], "/clusterz"))
+        assert idx["enabled"] is True
+        assert idx["latest"]["heat.topk_share"] > 0.0
+        assert idx["latest"]["heat.shard_imbalance"] == \
+            pytest.approx(500.0 / 300.0)
+    finally:
+        obs_server.set_clusterz_provider(None)
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# SLO: the heat_shard_imbalance rule latches one breach, then clears.
+# ---------------------------------------------------------------------------
+
+def test_slo_heat_imbalance_breach_latches_and_clears():
+    rule = [r for r in timeline.default_rules()
+            if r.name == "heat_shard_imbalance"]
+    assert len(rule) == 1 and rule[0].threshold == 4.0
+    wd = timeline.SloWatchdog(rule)
+    ring = timeline.TimelineRing(64)
+    # heat off: the metric is absent and the rule must stay silent
+    ring.append({"x.n": 1.0}, mono=50.0)
+    assert wd.evaluate(ring, now_mono=50.0) == []
+    for i in range(3):                           # healthy skew
+        ring.append({"heat.shard_imbalance": 1.2}, mono=100.0 + i)
+    assert wd.evaluate(ring, now_mono=102.0) == []
+    for i in range(3):                           # hot-shard collapse
+        ring.append({"heat.shard_imbalance": 8.0}, mono=200.0 + i)
+    trans = wd.evaluate(ring, now_mono=202.0)
+    assert [t["rule"] for t in trans] == ["heat_shard_imbalance"]
+    assert trans[0]["breached"] is True
+    for i in range(3, 8):                        # latched: no event storm
+        ring.append({"heat.shard_imbalance": 8.0}, mono=200.0 + i)
+        assert wd.evaluate(ring, now_mono=200.0 + i) == []
+    assert len(flight.events(kind="slo_breach")) == 1
+    for i in range(3):                           # recovery clears
+        ring.append({"heat.shard_imbalance": 1.1}, mono=300.0 + i)
+    trans = wd.evaluate(ring, now_mono=302.0)
+    assert trans and trans[0]["breached"] is False
+    assert len(flight.events(kind="slo_clear")) == 1
+
+
+# ---------------------------------------------------------------------------
+# Health verbs: train PS and serving replica carry the heat sub-dict.
+# ---------------------------------------------------------------------------
+
+def test_ps_health_carries_heat_subdict():
+    from paddlebox_tpu.ps.host_table import ShardedHostTable
+    from paddlebox_tpu.ps.service import PSClient, PSServer
+    flags.set_flags({"obs_heat": True})
+    tcfg = EmbeddingTableConfig(embedding_dim=4, shard_num=4)
+    srv = PSServer(ShardedHostTable(tcfg, seed=0))
+    try:
+        client = PSClient(srv.addr)
+        keys = _zipf_stream(n=5000, seed=3)
+        client.pull_sparse(np.unique(keys))
+        h = client.health()
+        assert h["ok"] is True
+        assert set(h["heat"]) >= {"topk_share", "shard_imbalance",
+                                  "working_set_rows"}
+        assert h["heat"]["working_set_rows"] > 0
+    finally:
+        srv.shutdown()
+
+
+def test_serving_health_carries_heat_subdict(tmp_path):
+    from paddlebox_tpu.io.checkpoint import save_xbox
+    from paddlebox_tpu.ps.host_table import ShardedHostTable
+    from paddlebox_tpu.ps.serving import ServingReplica, ServingRouter
+    cfg = EmbeddingTableConfig(embedding_dim=4)
+    tab = ShardedHostTable(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    keys = rng.choice(2 ** 30, 50, replace=False).astype(np.uint64)
+    rows = tab.bulk_pull(keys)
+    rows["show"] = rows["show"] + 20.0
+    rows["click"] = rows["click"] + 5.0
+    rows["mf_size"][:] = 4
+    tab.bulk_write(keys, rows)
+
+    class Eng:
+        pass
+    eng = Eng()
+    eng.table, eng.config = tab, cfg
+    save_xbox(eng, str(tmp_path / "d1"), base=True)
+
+    flags.set_flags({"obs_heat": True})
+    rep = ServingReplica(config=cfg, xbox_path=str(tmp_path / "d1"))
+    router = ServingRouter([rep.addr])
+    try:
+        router.pull_sparse(keys[:20])
+        h = router.health()[0]
+        assert "heat" in h and h["heat"]["topk_share"] >= 0.0
+        # the per-tenant serve site got the lookup batch
+        assert "serve.default" in heat.ACTIVE.raw()["sites"]
+    finally:
+        router.close()
+        rep.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# The contract: FLAGS_obs_heat is telemetry-only.  Bit-identity, using
+# the same 2-day x 3-pass DeepFM workload the device-cache suite pins.
+# ---------------------------------------------------------------------------
+
+def _simple_cfg():
+    return DataFeedConfig(slots=tuple(
+        [SlotConfig("label", dtype="float", is_dense=True, dim=1),
+         SlotConfig("dense0", dtype="float", is_dense=True, dim=3)]
+        + [SlotConfig(f"s{i}", slot_id=100 + i, capacity=CAP)
+           for i in range(4)]))
+
+
+def _simple_block(rng, n, n_keys=500):
+    blk = SlotRecordBlock(n=n)
+    for i in range(4):
+        lens = rng.integers(1, CAP + 1, size=n)
+        off = np.zeros((n + 1,), np.int64)
+        np.cumsum(lens, out=off[1:])
+        blk.uint64_slots[f"s{i}"] = (
+            rng.integers(1, n_keys, size=int(off[-1])).astype(np.uint64), off)
+    blk.float_slots["label"] = (rng.integers(0, 2, n).astype(np.float32),
+                                np.arange(n + 1, dtype=np.int64))
+    blk.float_slots["dense0"] = (
+        rng.normal(0, 1, n * 3).astype(np.float32),
+        np.arange(n + 1, dtype=np.int64) * 3)
+    return blk
+
+
+def _mk_ds(cfg, day, p):
+    ds = SlotDataset(cfg)
+    ds._blocks = [_simple_block(np.random.default_rng(100 * day + 10 * p),
+                                96)]
+    return ds
+
+
+def _day_keys(cfg):
+    parts = []
+    for day in range(N_DAYS):
+        for p in range(N_PASSES):
+            for b in _mk_ds(cfg, day, p).get_blocks():
+                parts.append(b.all_keys())
+    return np.unique(np.concatenate(parts))
+
+
+def _run_days(prefetch: bool, table=None):
+    cfg = _simple_cfg()
+    eng = BoxPSEngine(EmbeddingTableConfig(
+        embedding_dim=4, shard_num=4,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0)), seed=0)
+    if table is not None:
+        eng.table = table
+    model = DeepFM(num_slots=4, emb_width=3 + 4, dense_dim=3, hidden=(8,))
+    tr = SparseTrainer(eng, model, cfg, batch_size=B, seed=0,
+                       sparse_path="fast")
+    losses = []
+    if not prefetch:
+        for day in range(N_DAYS):
+            eng.set_date(f"2026080{day + 1}")
+            for p in range(N_PASSES):
+                ds = _mk_ds(cfg, day, p)
+                eng.begin_feed_pass()
+                for b in ds.get_blocks():
+                    eng.add_keys(b.all_keys())
+                eng.end_feed_pass()
+                eng.begin_pass()
+                feed = tr.build_pass_feed(ds)
+                losses.append(tr.train_pass(feed)["loss"])
+                eng.end_pass()
+        return losses, eng, tr
+
+    pre = PassPrefetcher(eng, tr)
+    try:
+        for day in range(N_DAYS):
+            for p in range(N_PASSES):
+                def load(day=day, p=p):
+                    ds = _mk_ds(cfg, day, p)
+                    for b in ds.get_blocks():
+                        eng.add_keys(b.all_keys())
+                    return ds
+                pre.submit(load, tag=f"d{day}p{p}",
+                           date=f"2026080{day + 1}")
+        for _ in range(N_DAYS * N_PASSES):
+            feed = pre.next_pass()
+            losses.append(tr.train_pass(feed)["loss"])
+            pre.end_pass()
+    finally:
+        pre.close()
+    return losses, eng, tr
+
+
+def _assert_runs_identical(a, b, keys):
+    losses1, eng1, tr1 = a
+    losses2, eng2, tr2 = b
+    np.testing.assert_array_equal(np.asarray(losses1), np.asarray(losses2))
+    s1, s2 = eng1.table.bulk_pull(keys), eng2.table.bulk_pull(keys)
+    assert set(s1) == set(s2)
+    for f in s1:
+        np.testing.assert_array_equal(np.asarray(s1[f]), np.asarray(s2[f]),
+                                      err_msg=f"table field {f!r}")
+    import jax
+    for p1, p2 in zip(jax.tree_util.tree_leaves(tr1.params),
+                      jax.tree_util.tree_leaves(tr2.params)):
+        np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+
+
+def _heat_on():
+    flags.set_flags({"obs_heat": True})
+
+
+def _heat_off():
+    flags.set_flags({"obs_heat": False})
+    heat.disable()
+
+
+@pytest.mark.parametrize("prefetch", [False, True])
+def test_heat_on_bit_identical(prefetch):
+    """Heat-on == heat-off, losses / final table / dense params, serial
+    and prefetched — while the sketches actually observed the run."""
+    keys = _day_keys(_simple_cfg())
+    _heat_off()
+    want = _run_days(prefetch=False)
+    _heat_on()
+    got = _run_days(prefetch=prefetch)
+    _assert_runs_identical(want, got, keys)
+    assert heat.ACTIVE is not None
+    raw = heat.ACTIVE.raw()
+    assert {"pull", "push"} <= set(raw["sites"])
+    assert stat_get("heat.working_set_rows") > 0
+    # the day boundary fired the decay snapshot exactly N_DAYS-1 times
+    assert len(flight.events(kind="heat_snapshot")) == N_DAYS - 1
+
+
+def test_heat_chaos_delta_mode_bit_identical():
+    """Heat + prefetch + delta-mode 2-shard remote PS under seeded
+    connection chaos: retries replay key batches into the sketches and
+    the sharded fan feeds the shard loads, but training must still land
+    bit-for-bit on the fault-free heat-off state."""
+    from paddlebox_tpu.launch import PSFleet
+    from paddlebox_tpu.ps import faults
+    from paddlebox_tpu.ps.service import PSClient, RemoteTableAdapter
+
+    tcfg = EmbeddingTableConfig(embedding_dim=4, shard_num=4,
+                                sgd=SparseSGDConfig(mf_create_thresholds=0.0))
+    keys = _day_keys(_simple_cfg())
+    flags.set_flags({"ps_fault_injection": True})
+    flt1 = flt2 = None
+    try:
+        flt1 = PSFleet(2, config=tcfg, seed=0)
+        client1 = PSClient(flt1.addrs, retries=None, retry_sleep=0.01,
+                           backoff_cap=0.1, deadline=60)
+        _heat_off()
+        want = _run_days(prefetch=False,
+                         table=RemoteTableAdapter(client1, delta_mode=True))
+
+        flt2 = PSFleet(2, config=tcfg, seed=0)
+        client2 = PSClient(flt2.addrs, retries=None, retry_sleep=0.01,
+                           backoff_cap=0.1, deadline=60)
+        _heat_on()
+        faults.install(
+            faults.FaultPlan(seed=17)
+            .drop("send", role="client", prob=0.04)
+            .drop("recv", role="client", prob=0.03)
+            .delay("send", 0.002, role="client", prob=0.1))
+        got = _run_days(prefetch=True,
+                        table=RemoteTableAdapter(client2, delta_mode=True))
+        faults.uninstall()
+
+        np.testing.assert_array_equal(np.asarray(want[0]),
+                                      np.asarray(got[0]))
+        s1, s2 = client1.pull_sparse(keys), client2.pull_sparse(keys)
+        for f in s1:
+            np.testing.assert_array_equal(s1[f], s2[f],
+                                          err_msg=f"table field {f!r}")
+        # the client fan fed the shard loads across both PS shards
+        assert heat.ACTIVE is not None
+        assert len(heat.ACTIVE.raw()["loads"]["l"]) == 2
+        assert stat_get("heat.shard_imbalance") > 0
+    finally:
+        faults.uninstall()
+        flags.set_flags({"ps_fault_injection": False})
+        for flt in (flt1, flt2):
+            if flt is not None:
+                flt.stop()

@@ -417,7 +417,9 @@ def test_pb204_flags_unbounded_dynamic_names():
         def f(key):
             stat_add(f"ps.keys.{key}", 1.0)
     """)
-    assert bad == ["PB204"]
+    # PB204 flags the unbounded dynamic name; PB208 additionally names the
+    # raw-feature-key disease and its sketch cure on the same site
+    assert bad == ["PB204", "PB208"]
     assert codes("""
         from paddlebox_tpu.utils.monitor import stat_add
         def f(rid):
@@ -441,10 +443,10 @@ def test_pb204_flags_unbounded_dynamic_names():
         def f(key):
             with trace.span(f"pass.{key}"):
                 pass
-    """) == ["PB204"]
+    """) == ["PB204", "PB208"]
     # suppression with a reason works like every other rule
     assert codes("""
         from paddlebox_tpu.utils.monitor import stat_add
         def f(key):
-            stat_add(f"ps.keys.{key}")  # pboxlint: disable=PB204 -- test
+            stat_add(f"ps.keys.{key}")  # pboxlint: disable=PB204,PB208 -- test
     """) == []

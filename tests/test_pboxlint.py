@@ -336,6 +336,20 @@ def test_pb206_literal_kind_must_be_lowercase_identifier():
     assert codes(src) == ["PB206"]
 
 
+def test_pb206_literal_kind_must_be_in_closed_taxonomy():
+    # the taxonomy is CLOSED: a lowercase literal kind that is not in
+    # KNOWN_KINDS is minted ad hoc — new kinds land by editing
+    # flight_events.KNOWN_KINDS in the same change
+    src = """
+    from paddlebox_tpu.utils import flight
+
+    def f():
+        flight.record("totally_new_kind")
+        flight.record("heat_snapshot")      # in the taxonomy: fine
+    """
+    assert codes(src) == ["PB206"]
+
+
 def test_pb206_unrelated_record_methods_out_of_scope():
     # bench.py's record(**kw) partials and ring.record(...) methods must
     # not trip the rule — sinks resolve through the flight import only
@@ -348,6 +362,48 @@ def test_pb206_unrelated_record_methods_out_of_scope():
         self._ring.record(f"x {rid}")
     """
     assert codes(src) == []
+
+
+def test_pb208_raw_key_in_metric_name():
+    # a 10^11-cardinality feature key minted into a stat name grows the
+    # registry one entry per hot key; the sketch types are the sink.
+    # PB204 flags the same site generically (unbounded f-string part) —
+    # PB208 names the disease, so both fire.
+    src = """
+    from paddlebox_tpu.utils.monitor import stat_add
+
+    def f(key, shard, n):
+        stat_add(f"ps.hot.{key}", n)
+        stat_add(f"ps.cluster.s{shard}.pull_keys", n)   # bounded: fine
+    """
+    assert sorted(codes(src)) == ["PB204", "PB208"]
+
+
+def test_pb208_raw_key_in_flight_kind():
+    src = """
+    from paddlebox_tpu.utils import flight
+
+    def f(feasign):
+        flight.record(f"hot_{feasign}", n=1)
+        flight.record("heat_imbalance", imbalance=4.5)  # fine
+    """
+    assert sorted(codes(src)) == ["PB206", "PB208"]
+
+
+def test_pb208_per_key_dict_in_obs_module():
+    # exact per-key state in the obs layer is unbounded memory by
+    # construction — only obs-module basenames are in scope, and
+    # utils/sketch.py is the sanctioned bounded sink
+    src = """
+    def bump(counts, key):
+        counts[key] = counts.get(key, 0) + 1
+
+    def seed(counts, feasign):
+        counts.setdefault(feasign, 0)
+    """
+    assert codes(src, path="monitor.py") == ["PB208", "PB208"]
+    assert codes(src, path="sketch.py") == []       # sanctioned sink
+    assert codes(src, path="host_table.py") == []   # not obs code
 
 
 # -- PB3xx JAX purity --------------------------------------------------------

@@ -202,6 +202,7 @@ def test_default_rules_reference_only_emitted_metrics():
         "queue_saturation": "ps.pool.table.queue_depth_hwm",
         "throughput_stall": "trainer.step_dispatch_s.count",
         "auc_drop": "quality.auc",
+        "heat_shard_imbalance": "heat.shard_imbalance",
     }
 
 
