@@ -63,6 +63,11 @@ BENCH_HEAT=0 (skip the heat-telemetry on/off overhead phase),
 BENCH_HEAT_PASSES/_CYCLES/_KEYS/_DRAWS (heat-phase geometry),
 BENCH_SERVING=0 (skip the serving-tier QPS/p99 phase),
 BENCH_SERVING_KEYS/_BATCHES/_BATCH (serving-phase geometry),
+BENCH_SERVING_FLEET=0 (skip the sharded-fleet + heat-routing sub-phases),
+BENCH_SERVING_FLEET_SHARDS/_ROUNDS/_BATCH/_REPS (fleet geometry),
+BENCH_SERVING_FLIP=0 (skip the streamed-delta-flip-under-load sub-phase),
+BENCH_SERVING_FLIP_GENS (save_pass generations streamed during traffic),
+BENCH_SERVING_HOT (replicated hot-key set size for the heat-routing leg),
 BENCH_CLUSTER=0 (skip the sharded-PS N=1 vs N=4 phase),
 BENCH_CLUSTER_KEYS/_ROUNDS/_BATCH/_SHARDS/_REPS (cluster-phase geometry),
 BENCH_MT=0 (skip the trainer-fleet N=1 vs N=4 phase),
@@ -550,7 +555,13 @@ def _recovery_drill(tag, dataset, engine, trainer):
     live table + dense state to a scratch generation root
     (io/checkpoint.py), drops the engine's feed state on the floor (the
     abrupt-death analogue), restores from the generation chain, and
-    re-drives one pass — the first completed batch stops the clock."""
+    re-drives one pass — the first completed batch stops the clock.
+
+    MTTR is a wall-clock-class metric (one kill → one restore interval,
+    scheduler-noise-dominated), so the drill runs THREE kill/resume
+    cycles from the same saved generation and reports the median with
+    the per-cycle ``runs`` alongside: --compare only gates a delta that
+    reproduces across a median-of-3 record on both sides."""
     import shutil as _shutil
     import tempfile as _tempfile
     from paddlebox_tpu.io.checkpoint import TrainCheckpoint
@@ -564,30 +575,34 @@ def _recovery_drill(tag, dataset, engine, trainer):
         gen = ck.save(engine, trainer)
         save_s = time.perf_counter() - t0
 
-        t_kill = time.perf_counter()
-        engine.reset_feed_state()   # the crashed run's in-flight state
-        ck.resume(engine, trainer)
-        restore_s = time.perf_counter() - t_kill
+        runs, restores = [], []
+        for cyc in range(3):
+            t_kill = time.perf_counter()
+            engine.reset_feed_state()   # the crashed run's in-flight state
+            ck.resume(engine, trainer)
+            restores.append(time.perf_counter() - t_kill)
 
-        first = [None]
+            first = [None]
 
-        def progress(n):
-            if first[0] is None:
-                first[0] = time.perf_counter()
-            set_phase(f"{tag}:recovery-drill[batch {n}]", 300)
+            def progress(n):
+                if first[0] is None:
+                    first[0] = time.perf_counter()
+                set_phase(f"{tag}:recovery-drill[run {cyc} batch {n}]", 300)
 
-        engine.begin_feed_pass()
-        for blk in dataset.get_blocks():
-            engine.add_keys(blk.all_keys())
-        engine.end_feed_pass()
-        engine.begin_pass()
-        feed = trainer.build_pass_feed(dataset)
-        trainer.train_pass(feed, progress=progress)
-        engine.end_pass()
-        t_first = first[0] or time.perf_counter()
-        return {"mttr_s": round(t_first - t_kill, 3),
+            engine.begin_feed_pass()
+            for blk in dataset.get_blocks():
+                engine.add_keys(blk.all_keys())
+            engine.end_feed_pass()
+            engine.begin_pass()
+            feed = trainer.build_pass_feed(dataset)
+            trainer.train_pass(feed, progress=progress)
+            engine.end_pass()
+            t_first = first[0] or time.perf_counter()
+            runs.append(round(t_first - t_kill, 3))
+        return {"mttr_s": sorted(runs)[1],
+                "runs": sorted(runs),
                 "save_s": round(save_s, 3),
-                "restore_s": round(restore_s, 3),
+                "restore_s": round(sorted(restores)[1], 3),
                 "generation": int(gen)}
     finally:
         _shutil.rmtree(root, ignore_errors=True)
@@ -834,31 +849,362 @@ def _serving_bench(tag):
                     - warm.get(key, 0.0))
 
         router.pull_sparse(batches[0])          # connect + compile warm
-        t0 = time.perf_counter()
-        for i, b in enumerate(batches):
-            if i % 50 == 0:
-                set_phase(f"{tag}:serving[{i}/{n_batches}]", 300)
-            router.pull_sparse(b)
-        wall = time.perf_counter() - t0
+        # QPS is a wall-clock-class metric: three full sweeps, report the
+        # median plus the per-run list — --compare only gates a delta
+        # that reproduces across a median-of-3 record on both sides
+        walls = []
+        for run in range(3):
+            t0 = time.perf_counter()
+            for i, b in enumerate(batches):
+                if i % 50 == 0:
+                    set_phase(f"{tag}:serving[run {run} "
+                              f"{i}/{n_batches}]", 300)
+                router.pull_sparse(b)
+            walls.append(time.perf_counter() - t0)
+        runs = sorted(round(n_batches / max(w, 1e-9), 1) for w in walls)
+        wall = sorted(walls)[1]
 
         snap = stat_snapshot("serving.")
         p99_s = float(snap.get("serving.default.latency_s.p99", 0.0))
         p50_s = float(snap.get("serving.default.latency_s.p50", 0.0))
-        queries = delta("serving.default.qps") or float(n_batches)
+        queries = delta("serving.default.qps") or float(3 * n_batches)
         shed = delta("serving.default.shed")
-        return {"qps": round(n_batches / max(wall, 1e-9), 1),
-                "keys_per_s": round(n_batches * batch / max(wall, 1e-9)),
-                "p50_ms": round(p50_s * 1000, 3),
-                "p99_ms": round(p99_s * 1000, 3),
-                "shed_rate": round(shed / max(queries, 1.0), 4),
-                "batch": batch, "batches": n_batches,
-                "resident_keys": n_keys, "zipf_a": 1.3,
-                "load_s": round(load_s, 3)}
+        out = {"qps": runs[1], "runs": runs,
+               "keys_per_s": round(n_batches * batch / max(wall, 1e-9)),
+               "p50_ms": round(p50_s * 1000, 3),
+               "p99_ms": round(p99_s * 1000, 3),
+               "shed_rate": round(shed / max(queries, 1.0), 4),
+               "batch": batch, "batches": n_batches,
+               "resident_keys": n_keys, "zipf_a": 1.3,
+               "load_s": round(load_s, 3)}
+        if os.environ.get("BENCH_SERVING_FLEET", "1") == "1":
+            out["fleet"] = _serving_fleet_bench(tag, cfg, dump, keys, rng)
+            out["heat_routing"] = _serving_heat_bench(tag, cfg, dump,
+                                                      keys, batches)
+        if os.environ.get("BENCH_SERVING_FLIP", "1") == "1":
+            out["flip"] = _serving_flip_bench(tag)
+        return out
     finally:
         if router is not None:
             router.close()
         if rep is not None:
             rep.shutdown()
+        _shutil.rmtree(root, ignore_errors=True)
+
+
+def _serving_fleet_bench(tag, cfg, dump, keys, rng):
+    """Sharded-fleet sub-phase: the SAME xbox dump served by a 4-shard
+    ServerMap-partitioned fleet (hot set replicated, the full tentpole
+    shape) vs one full-table replica, over identical zipf blocks.
+
+    Fleet throughput is the BOTTLENECK-SHARD basis: serving requests are
+    independent — there is no cross-request barrier, so steady-state QPS
+    is total rounds over the most-loaded shard's TOTAL busy seconds (a
+    round's verbs queue behind earlier rounds on the same shard, they do
+    not wait for sibling shards).  This differs deliberately from the
+    cluster bench's per-round critical path, which models
+    barrier-synchronized training fan-outs.  Each verb's service time is
+    measured uncontended (min over reps): every replica shares this
+    interpreter, so concurrent wall clock would measure GIL contention,
+    not serving capacity — the live sharded-router fan is reported
+    separately as fan_wall_s.
+
+    Routing mirrors the router exactly: cold keys go to their ServerMap
+    owner, the replicated hot bundle goes to ONE group per round,
+    rotating round-robin — the balanced-load limit that p2c-over-EWMAs
+    converges to when groups are symmetric (the router's actual p2c
+    draws are load-feedback-driven and unreproducible across runs;
+    rotation is the deterministic stand-in with the same long-run
+    per-shard totals)."""
+    from paddlebox_tpu.ps import cluster as ps_cluster
+    from paddlebox_tpu.ps.serving import ServingReplica, ServingRouter
+
+    n_shards = int(os.environ.get("BENCH_SERVING_FLEET_SHARDS", 4))
+    n_rounds = int(os.environ.get("BENCH_SERVING_FLEET_ROUNDS", 30))
+    # batch sized like a full mini-batch lookup (1k ads x ~100 slots):
+    # big enough that the ~0.7 ms per-verb fixed cost is noise and the
+    # response-assembly memory behavior — which is where a full-table
+    # replica actually loses to a sharded fleet — shows through
+    batch = int(os.environ.get("BENCH_SERVING_FLEET_BATCH", 131072))
+    reps = max(1, int(os.environ.get("BENCH_SERVING_FLEET_REPS", 2)))
+    n_hot = int(os.environ.get("BENCH_SERVING_HOT", 64))
+    n_keys = len(keys)
+    hot = np.sort(keys[:n_hot])     # zipf rank order: keys[0] hottest
+    blocks = [keys[np.minimum(rng.zipf(1.3, size=batch), n_keys) - 1]
+              for _ in range(n_rounds)]
+
+    def split(b):
+        """(cold per-shard partitions, hot bundle) of one block."""
+        pos = np.minimum(np.searchsorted(hot, b), len(hot) - 1)
+        hit = hot[pos] == b
+        cold = b[~hit]
+        return ([cold[ps_cluster.owned_mask(cold, s, n_shards)]
+                 for s in range(n_shards)], b[hit])
+
+    parts = [split(b) for b in blocks]
+
+    solo, fleet, routers = None, [], []
+    try:
+        solo = ServingReplica(config=cfg, xbox_path=dump, port=0)
+        r1 = ServingRouter([solo.addr])
+        routers.append(r1)
+        fleet = [ServingReplica(config=cfg, xbox_path=dump, shard=s,
+                                n_shards=n_shards, hot_keys=hot)
+                 for s in range(n_shards)]
+        per = [ServingRouter([rep.addr]) for rep in fleet]
+        routers.extend(per)
+        rfan = ServingRouter(shard_groups=[[rep.addr] for rep in fleet],
+                             hot_keys=hot, seed=17)
+        routers.append(rfan)
+
+        r1.pull_sparse(blocks[0])               # connect warm, all paths
+        rfan.pull_sparse(blocks[0])
+        for rt, p in zip(per, parts[0][0]):
+            if len(p):
+                rt.pull_sparse(p)
+
+        def t_pull(rt, b):
+            t0 = time.perf_counter()
+            rt.pull_sparse(b)
+            return time.perf_counter() - t0
+
+        solo_wall = 0.0
+        busy = [0.0] * n_shards
+        for i, (b, (cold, hotb)) in enumerate(zip(blocks, parts)):
+            if i % 5 == 0:
+                set_phase(f"{tag}:serving[fleet {i}/{n_rounds}]", 300)
+            solo_wall += min(t_pull(r1, b) for _ in range(reps))
+            for s in range(n_shards):
+                if len(cold[s]):
+                    busy[s] += min(t_pull(per[s], cold[s])
+                                   for _ in range(reps))
+            if len(hotb):
+                g = i % n_shards
+                busy[g] += min(t_pull(per[g], hotb) for _ in range(reps))
+        bottleneck = max(busy)
+        t0 = time.perf_counter()
+        for b in blocks:                        # live fan: GIL-contended
+            rfan.pull_sparse(b)
+        fan_wall = time.perf_counter() - t0
+        return {"n_shards": n_shards, "rounds": n_rounds, "batch": batch,
+                "hot_keys": n_hot,
+                "solo_wall_s": round(solo_wall, 3),
+                "bottleneck_busy_s": round(bottleneck, 3),
+                "busy_s": [round(x, 3) for x in busy],
+                "fan_wall_s": round(fan_wall, 3),
+                "solo_qps": round(n_rounds / max(solo_wall, 1e-9), 1),
+                "qps": round(n_rounds / max(bottleneck, 1e-9), 1),
+                "speedup": round(solo_wall / max(bottleneck, 1e-9), 2)}
+    finally:
+        for rt in routers:
+            rt.close()
+        for rep in ([solo] if solo is not None else []) + fleet:
+            rep.shutdown()
+
+
+def _serving_heat_bench(tag, cfg, dump, keys, batches):
+    """Heat-replication on/off shard-imbalance comparison over the SAME
+    zipf stream the solo phase drove.  The off leg is exact owner
+    accounting — heat-off routing is deterministic ServerMap placement,
+    so per-shard loads follow from owned_mask with no serving needed.
+    The on leg drives a REAL hot-replicated fleet through the sharded
+    router from four concurrent threads — p2c balances on LIVE
+    outstanding-load feedback, so sequential driving would degenerate it
+    to an EWMA tie-break — and the cold part is accounted to its owners
+    (still deterministic) while the hot part lands wherever p2c actually
+    sent it (the router's own observe_shard taps).  Both legs publish
+    through a fresh HeatMap load sketch; the gate is
+    imbalance_on < imbalance_off."""
+    from paddlebox_tpu.ps import cluster as ps_cluster
+    from paddlebox_tpu.ps import heat
+    from paddlebox_tpu.ps.serving import ServingReplica, ServingRouter
+    from paddlebox_tpu.utils.monitor import stat_get, stat_snapshot
+
+    n_shards = int(os.environ.get("BENCH_SERVING_FLEET_SHARDS", 4))
+    n_hot = int(os.environ.get("BENCH_SERVING_HOT", 64))
+    hot = np.sort(keys[:n_hot])     # zipf rank order: keys[0] hottest
+
+    def owner_counts(b, counts):
+        for s in range(n_shards):
+            counts[s] += int(ps_cluster.owned_mask(b, s, n_shards).sum())
+
+    fleet, router = [], None
+    heat.disable()
+    hm = heat.enable()
+    try:
+        counts = np.zeros(n_shards)
+        for b in batches:               # off leg: everything to its owner
+            owner_counts(b, counts)
+        for s in range(n_shards):
+            hm.observe_shard(s, counts[s])
+        imb_off = float(stat_snapshot("heat.")
+                        .get("heat.shard_imbalance", 0.0))
+
+        heat.disable()                  # fresh load sketch for the on leg
+        hm = heat.enable()
+        fleet = [ServingReplica(config=cfg, xbox_path=dump, shard=s,
+                                n_shards=n_shards, hot_keys=hot)
+                 for s in range(n_shards)]
+        router = ServingRouter(shard_groups=[[r.addr] for r in fleet],
+                               hot_keys=hot, seed=17)
+        routed0 = stat_get("serving.router.hot_routed")
+        set_phase(f"{tag}:serving[heat 0/{len(batches)}]", 300)
+        errs = []
+
+        def drive(lane):
+            try:
+                for b in batches[lane::4]:  # hot part: real p2c routing
+                    router.pull_sparse(b)
+            except Exception as e:          # noqa: BLE001 — surfaced below
+                errs.append(repr(e))
+
+        lanes = [threading.Thread(target=drive, args=(ln,))
+                 for ln in range(4)]
+        for t in lanes:
+            t.start()
+        for t in lanes:
+            t.join(timeout=120)
+        if errs:
+            raise RuntimeError(f"heat-routing leg failed: {errs[:2]}")
+        counts = np.zeros(n_shards)
+        hot_n = total = 0
+        for b in batches:
+            pos = np.searchsorted(hot, b)
+            pos = np.minimum(pos, len(hot) - 1)
+            cold = b[hot[pos] != b]
+            hot_n += len(b) - len(cold)
+            total += len(b)
+            owner_counts(cold, counts)
+        for s in range(n_shards):
+            if counts[s]:
+                hm.observe_shard(s, counts[s])
+        imb_on = float(stat_snapshot("heat.")
+                       .get("heat.shard_imbalance", 0.0))
+        return {"hot_keys": n_hot,
+                "hot_share": round(hot_n / max(total, 1), 4),
+                "hot_routed": int(stat_get("serving.router.hot_routed")
+                                  - routed0),
+                "imbalance_off": round(imb_off, 4),
+                "imbalance_on": round(imb_on, 4),
+                "imbalance_ratio": round(imb_on / max(imb_off, 1e-9), 4)}
+    finally:
+        heat.disable()
+        if router is not None:
+            router.close()
+        for rep in fleet:
+            rep.shutdown()
+
+
+def _serving_flip_bench(tag):
+    """Streamed-freshness sub-phase: a 4-shard fleet fed by watch_ckpt
+    takes save_pass delta generations (base_every=2, so the stream
+    crosses a compaction re-base) while router traffic runs — the
+    acceptance numbers are ZERO failed requests across every flip and
+    the observed serving.staleness_s histogram (commit-to-swap lag)."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from paddlebox_tpu.config import EmbeddingTableConfig, SparseSGDConfig
+    from paddlebox_tpu.io.checkpoint import TrainCheckpoint
+    from paddlebox_tpu.ps.pass_manager import BoxPSEngine
+    from paddlebox_tpu.ps.serving import ServingReplica, ServingRouter
+    from paddlebox_tpu.utils.monitor import stat_snapshot
+
+    n_shards = 4
+    n_gens = int(os.environ.get("BENCH_SERVING_FLIP_GENS", 4))
+
+    class _Dense:
+        def __init__(self):
+            self.params = {"w": np.zeros(3, np.float32)}
+            self.opt_state = {"m": np.zeros((2, 2), np.float32)}
+
+    def grow(ck, eng, tr, p):
+        pk = np.unique(np.random.default_rng(p).integers(
+            1, 4000, size=600).astype(np.uint64))
+        eng.begin_feed_pass()
+        eng.add_keys(pk)
+        eng.end_feed_pass()
+        eng.begin_pass()
+        eng.ws["show"] = eng.ws["show"] + float(p + 1)
+        eng.end_pass()
+        ck.save_pass(eng, tr)
+
+    cfg = EmbeddingTableConfig(
+        embedding_dim=4, shard_num=4,
+        sgd=SparseSGDConfig(mf_create_thresholds=0.0))
+    root = _tempfile.mkdtemp(prefix="bench_serving_flip_")
+    fleet, router = [], None
+    stop = threading.Event()
+    threads = []
+    warm = stat_snapshot("serving.")
+    try:
+        eng = BoxPSEngine(cfg, seed=0)
+        eng.set_date("20260807")
+        tr = _Dense()
+        ck = TrainCheckpoint(root, keep=4, base_every=2)
+        ck.save(eng, tr)
+        grow(ck, eng, tr, 0)
+        fleet = [ServingReplica(config=cfg, ckpt_root=root, shard=s,
+                                n_shards=n_shards)
+                 for s in range(n_shards)]
+        for rep in fleet:
+            rep.watch_ckpt(poll_s=0.1)
+        router = ServingRouter(shard_groups=[[r.addr] for r in fleet])
+        q = np.unique(np.random.default_rng(99).integers(
+            1, 4200, size=800).astype(np.uint64))
+        errors, pulls = [], [0]
+
+        def traffic():
+            while not stop.is_set():
+                try:
+                    rows = router.pull_sparse(q)
+                    if len(rows["embed_w"]) != len(q):
+                        errors.append("short read")
+                    pulls[0] += 1
+                except Exception as e:      # the count IS the metric
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=traffic) for _ in range(2)]
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        for p in range(1, 1 + n_gens):
+            set_phase(f"{tag}:serving[flip {p}/{n_gens}]", 300)
+            grow(ck, eng, tr, p)
+            time.sleep(0.3)     # every watcher sees THIS head → deltas
+        head = ck.head()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not all(
+                rep._gen.generation == head for rep in fleet):
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        snap = stat_snapshot("serving.")
+
+        def delta(k):
+            return snap.get(k, 0.0) - warm.get(k, 0.0)
+
+        return {"failed_requests": len(errors),
+                "pulls_during_flips": int(pulls[0]),
+                "flips": int(delta("serving.delta_flip")),
+                "converged": bool(all(rep._gen.generation == head
+                                      for rep in fleet)),
+                "head_generation": int(head),
+                "staleness_p50_s": round(float(
+                    snap.get("serving.staleness_s.p50", 0.0)), 3),
+                "staleness_p99_s": round(float(
+                    snap.get("serving.staleness_s.p99", 0.0)), 3),
+                "wall_s": round(time.perf_counter() - t0, 3),
+                "errors": errors[:3]}
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        if router is not None:
+            router.close()
+        for rep in fleet:
+            rep.shutdown(drain_timeout=2.0)
         _shutil.rmtree(root, ignore_errors=True)
 
 
@@ -1622,9 +1968,43 @@ def run_config(tag, batch_size, n_batches, n_keys, pack_threads):
             record(serving_qps=serving["qps"],
                    serving_p99_ms=serving["p99_ms"])
             trace(f"{tag}: serving qps={serving['qps']:.1f} "
-                  f"({serving['keys_per_s']:,} keys/s) "
+                  f"(median of {serving['runs']}; "
+                  f"{serving['keys_per_s']:,} keys/s) "
                   f"p99={serving['p99_ms']:.2f}ms "
                   f"shed_rate={serving['shed_rate']:.4f}")
+            flt = serving.get("fleet") or {}
+            if flt:
+                record(serving_fleet_speedup=flt["speedup"])
+                trace(f"{tag}: serving fleet n{flt['n_shards']}="
+                      f"{flt['qps']:.1f} qps (critical-path basis) vs "
+                      f"solo {flt['solo_qps']:.1f} "
+                      f"speedup={flt['speedup']:.2f}x "
+                      f"fan_wall={flt['fan_wall_s']:.2f}s")
+                if flt["speedup"] < 3.0:
+                    trace(f"{tag}: WARNING serving fleet speedup below "
+                          "the 3x acceptance floor at N=4")
+            flip = serving.get("flip") or {}
+            if flip:
+                record(serving_staleness_p99_s=flip["staleness_p99_s"])
+                trace(f"{tag}: serving flip head="
+                      f"{flip['head_generation']} "
+                      f"flips={flip['flips']} "
+                      f"failed={flip['failed_requests']} "
+                      f"pulls={flip['pulls_during_flips']} "
+                      f"staleness_p99={flip['staleness_p99_s']:.2f}s")
+                if flip["failed_requests"]:
+                    trace(f"{tag}: WARNING requests failed during the "
+                          "streamed delta flip")
+            hr = serving.get("heat_routing") or {}
+            if hr:
+                trace(f"{tag}: serving heat routing shard_imbalance "
+                      f"{hr['imbalance_off']:.2f} -> "
+                      f"{hr['imbalance_on']:.2f} "
+                      f"(ratio {hr['imbalance_ratio']:.2f}, "
+                      f"hot_share {hr['hot_share']:.2f})")
+                if hr["imbalance_ratio"] >= 1.0:
+                    trace(f"{tag}: WARNING hot-key replication did not "
+                          "cut shard imbalance")
         except Exception as e:  # phase is diagnostic, never fatal
             trace(f"{tag}: serving bench failed: {type(e).__name__}: {e}")
 
@@ -2057,6 +2437,21 @@ def _load_result(path):
                      "(no 'metric' or 'parsed' key)")
 
 
+def _reproduced_drop(runs_old, runs_new, old_val, threshold, sign=-1):
+    """Median-of-3 discipline for wall-clock-class metrics (serving.qps,
+    recovery.mttr_s): the delta gates only when BOTH records carry the
+    per-run list (len >= 3, i.e. the phase ran its median-of-3 loop) and
+    the regression direction reproduces on at least 2 of the new runs
+    against the old median.  sign=-1 gates drops, sign=+1 gates growth."""
+    if not (isinstance(runs_old, list) and len(runs_old) >= 3
+            and isinstance(runs_new, list) and len(runs_new) >= 3):
+        return False
+    hits = sum(1 for r in runs_new
+               if isinstance(r, (int, float))
+               and sign * (float(r) - old_val) / old_val > threshold)
+    return hits >= 2
+
+
 def compare(old_path: str, new_path: str, threshold=None) -> int:
     """Diff two BENCH result files; 0 = within threshold, 1 = regression.
 
@@ -2176,8 +2571,18 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
         out["serving_qps"] = {"old": qo, "new": qn,
                               "delta_frac": round(qfrac, 4)}
         if qfrac < -threshold:
-            regressions.append(
-                f"serving.qps {qo:.1f} -> {qn:.1f} ({qfrac:+.1%})")
+            # wall-clock-class metric: one sweep on a contended CPU host
+            # swings past any sane threshold on scheduler noise alone, so
+            # the delta only GATES when both records are medians-of-3 AND
+            # the drop reproduces (>= 2 of the new runs individually
+            # clear the threshold vs the old median); otherwise it is
+            # report-only drift
+            if _reproduced_drop(svo.get("runs"), svn.get("runs"),
+                                qo, threshold):
+                regressions.append(
+                    f"serving.qps {qo:.1f} -> {qn:.1f} ({qfrac:+.1%})")
+            else:
+                out["serving_qps"]["report_only_drift"] = True
     po, pn = num(svo, "p99_ms"), num(svn, "p99_ms")
     if po and pn is not None:           # higher serving p99 = regression
         pfrac = (pn - po) / po
@@ -2195,6 +2600,60 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
         if shn > sho + 0.01:
             regressions.append(
                 f"serving.shed_rate {sho:.4f} -> {shn:.4f}")
+    flo, fln = svo.get("fleet") or {}, svn.get("fleet") or {}
+    fso, fsn = num(flo, "speedup"), num(fln, "speedup")
+    if fsn is not None:                 # sharded fleet must beat solo
+        # absolute acceptance floor (critical-path basis, so the number
+        # is service-time arithmetic, not scheduler luck) plus the usual
+        # relative gate against the old record
+        out["serving_fleet_speedup"] = {"old": fso, "new": fsn}
+        if fsn < 3.0:
+            regressions.append(
+                f"serving.fleet.speedup {fsn:.2f}x below the 3x "
+                f"acceptance floor at N="
+                f"{int(num(fln, 'n_shards') or 4)}")
+        elif fso and (fsn - fso) / fso < -threshold:
+            regressions.append(
+                f"serving.fleet.speedup {fso:.2f}x -> {fsn:.2f}x")
+    fpo, fpn = svo.get("flip") or {}, svn.get("flip") or {}
+    ffn = num(fpn, "failed_requests")
+    if ffn is not None:                 # ANY failed request during a
+        out["serving_flip_failed"] = {  # streamed flip = regression
+            "old": num(fpo, "failed_requests"), "new": ffn,
+            "errors": fpn.get("errors", [])}
+        if ffn > 0:
+            regressions.append(
+                f"serving.flip.failed_requests {int(ffn)} "
+                f"(errors: {fpn.get('errors', [])})")
+        if fpn.get("converged") is False:
+            regressions.append(
+                "serving.flip fleet never converged to the manifest head")
+    spo, spn = num(fpo, "staleness_p99_s"), num(fpn, "staleness_p99_s")
+    if spn is not None:                 # freshness lag is the product:
+        # p99 commit-to-swap staleness is gated on half-again growth
+        # over the old record with a 1 s absolute deadband (one poll
+        # cadence + patch build), plus a 10 s hard ceiling — past that
+        # the delta stream is not "delta-fresh" regardless of baseline
+        out["serving_staleness_p99_s"] = {"old": spo, "new": spn}
+        if spn > 10.0:
+            regressions.append(
+                f"serving.flip.staleness_p99_s {spn:.2f} above the 10 s "
+                f"freshness ceiling")
+        elif spo and spn > 1.5 * spo and (spn - spo) > 1.0:
+            regressions.append(
+                f"serving.flip.staleness_p99_s {spo:.2f} -> {spn:.2f}")
+    hro, hrn = svo.get("heat_routing") or {}, svn.get("heat_routing") or {}
+    rto, rtn = num(hro, "imbalance_ratio"), num(hrn, "imbalance_ratio")
+    if rtn is not None:                 # hot-key replication must CUT
+        # shard imbalance vs owner-only routing: ratio >= 1 means the
+        # p2c hot path stopped paying for its replicated rows
+        out["serving_heat_imbalance_ratio"] = {"old": rto, "new": rtn}
+        if rtn >= 1.0:
+            regressions.append(
+                f"serving.heat_routing.imbalance_ratio {rtn:.2f} — "
+                f"hot-key replication no longer cuts shard imbalance "
+                f"(off {num(hrn, 'imbalance_off')} -> "
+                f"on {num(hrn, 'imbalance_on')})")
     clo = num(old.get("cluster") or {}, "wire_speedup")
     cln = num(new.get("cluster") or {}, "wire_speedup")
     if clo and cln is not None:         # lower fan-out speedup = regression
@@ -2258,15 +2717,22 @@ def compare(old_path: str, new_path: str, threshold=None) -> int:
         if tmo and (tmn - tmo) / tmo > max(threshold, 0.5):
             regressions.append(
                 f"multi_trainer.restart_mttr_s {tmo:.2f} -> {tmn:.2f}")
-    mo = num(old.get("recovery") or {}, "mttr_s")
-    mn = num(new.get("recovery") or {}, "mttr_s")
+    rco, rcn = old.get("recovery") or {}, new.get("recovery") or {}
+    mo, mn = num(rco, "mttr_s"), num(rcn, "mttr_s")
     if mo and mn is not None:           # slower recovery = regression
         mfrac = (mn - mo) / mo
         out["mttr_s"] = {"old": mo, "new": mn,
                          "delta_frac": round(mfrac, 4)}
         if mfrac > threshold:
-            regressions.append(
-                f"recovery.mttr_s {mo:.3f} -> {mn:.3f} ({mfrac:+.1%})")
+            # wall-clock-class: same median-of-3 discipline as
+            # serving.qps — gate only a reproduced growth, report drift
+            # otherwise
+            if _reproduced_drop(rco.get("runs"), rcn.get("runs"),
+                                mo, threshold, sign=1):
+                regressions.append(
+                    f"recovery.mttr_s {mo:.3f} -> {mn:.3f} ({mfrac:+.1%})")
+            else:
+                out["mttr_s"]["report_only_drift"] = True
     bo = num(old.get("timeline") or {}, "slo_breaches") or 0.0
     bn = num(new.get("timeline") or {}, "slo_breaches")
     if bn is not None:                  # new SLO breaches = regression

@@ -50,7 +50,7 @@ import shutil
 import tempfile
 import time
 import warnings
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import jax
@@ -311,6 +311,96 @@ class TrainCheckpoint:
         for n in chain[1:]:
             table.load(sparse_dir(n), mode="upsert")
         return head
+
+    # -- generation readers (the serving tier's delta-stream surface) --------
+    # ps/serving.py's ckpt watcher consumes committed generations row-wise
+    # (filtered to its shard + hot set) without ever owning a mutable
+    # ShardedHostTable, so the chain-walk internals get a public read-only
+    # face here instead of the serving tier poking at _manifest/_state.
+    def head(self) -> Optional[int]:
+        """Committed head generation number (MANIFEST pointer), or None
+        when nothing has ever committed.  Raises on a torn MANIFEST read
+        (json decode) — watchers retry with bounded backoff
+        (ServingReplica.watch_ckpt's manifest_retry discipline)."""
+        return self._manifest()
+
+    def gen_state(self, n: int) -> Dict:
+        """STATE dict of committed generation ``n`` (kind/chain/day_id/
+        pass_id/shards) — stable once the generation dir is renamed in."""
+        return self._state(n)
+
+    def gen_mtime(self, n: int) -> float:
+        """Commit wall-time of generation ``n`` (its STATE.json mtime) —
+        the freshness basis for serving.staleness_s."""
+        return os.path.getmtime(
+            os.path.join(self._gen_dir(n), "STATE.json"))
+
+    def gen_sparse_dirs(self, n: int) -> List[str]:
+        """Sparse dump dirs of generation ``n``: the flat ``sparse/`` dir
+        for a single-table save, else its per-cluster-shard
+        ``shard-<k:03d>/`` subdirs (cluster_save layout) — the trainer's
+        shard count need not match a serving reader's, so readers walk
+        every subdir and re-filter by key hash themselves."""
+        base = os.path.join(self._gen_dir(n), "sparse")
+        subs = sorted(
+            os.path.join(base, d) for d in os.listdir(base)
+            if d.startswith("shard-")
+            and os.path.isdir(os.path.join(base, d))) \
+            if os.path.isdir(base) else []
+        return subs or [base]
+
+    def read_gen_rows(self, n: int, template: Dict[str, np.ndarray],
+                      missing_fill: Optional[Dict[str, float]] = None
+                      ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """All rows of generation ``n`` as ``(keys, soa)`` arrays, field
+        set conformed to ``template`` (a one-row dict giving each field's
+        dtype + trailing shape — fv.default_rows_keyed output works).
+
+        Mirrors ShardedHostTable.load's checkpoint-compat rules so a
+        serving-side chain replay lands bit-identical state: fields the
+        dump lacks init like fresh rows (0, or ``missing_fill``'s value
+        for fields whose name ends with one of its suffixes — the adam
+        beta-power trackers), and the template dtype wins over the
+        dump's.  Keys are unique within one generation by construction
+        (table keys are unique per shard and shards partition the key
+        space), so callers may apply the dict order-free within a
+        generation and in chain order across them."""
+        keys_parts: List[np.ndarray] = []
+        soa_parts: Dict[str, List[np.ndarray]] = {f: [] for f in template}
+        for d in self.gen_sparse_dirs(n):
+            if not os.path.isdir(d):
+                continue
+            for fname in sorted(os.listdir(d)):
+                if not fname.endswith(".shard.npz"):
+                    continue
+                with np.load(os.path.join(d, fname)) as z:
+                    part_keys = np.asarray(z["keys"], np.uint64)
+                    if not len(part_keys):
+                        continue
+                    keys_parts.append(part_keys)
+                    for f, tmpl in template.items():
+                        tmpl = np.asarray(tmpl)
+                        if f in z.files:
+                            arr = z[f]
+                            if arr.dtype != tmpl.dtype:
+                                arr = arr.astype(tmpl.dtype)
+                        else:
+                            fill = next(
+                                (v for suf, v in (missing_fill
+                                                  or {}).items()
+                                 if f.endswith(suf)), 0.0)
+                            arr = np.full(
+                                (len(part_keys),) + tmpl.shape[1:],
+                                fill, tmpl.dtype)
+                        soa_parts[f].append(arr)
+        if not keys_parts:
+            empty = {f: np.zeros((0,) + np.asarray(t).shape[1:],
+                                 np.asarray(t).dtype)
+                     for f, t in template.items()}
+            return np.zeros(0, np.uint64), empty
+        return (np.concatenate(keys_parts),
+                {f: np.concatenate(parts)
+                 for f, parts in soa_parts.items()})
 
     def read_state(self) -> Optional[Dict]:
         """The head generation's STATE dict (day/pass cursor + any

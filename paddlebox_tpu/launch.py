@@ -952,7 +952,9 @@ class ServingReplicaSupervisor:
                  host: str = "127.0.0.1", port: int = 0,
                  max_restarts: int = 8, backoff_base: float = 0.05,
                  backoff_cap: float = 1.0, poll_s: float = 0.02,
-                 watch_s: float = 0.0, seed: int = 0):
+                 watch_s: float = 0.0, seed: int = 0,
+                 shard: int = 0, n_shards: int = 1,
+                 ckpt_root: Optional[str] = None, hot_keys=None):
         from paddlebox_tpu.ps.serving import ServingReplica
         self._make = ServingReplica
         self.config = config
@@ -963,6 +965,10 @@ class ServingReplicaSupervisor:
         self.host = host
         self.watch_s = watch_s
         self.seed = seed
+        self.shard = int(shard)
+        self.n_shards = max(1, int(n_shards))
+        self.ckpt_root = ckpt_root
+        self.hot_keys = hot_keys
         self.max_restarts = int(max_restarts)
         self.restarts = 0
         self._backoff = (backoff_base, backoff_cap)
@@ -972,7 +978,9 @@ class ServingReplicaSupervisor:
         self.replica = ServingReplica(
             config=config, xbox_path=path, tenants=tenants,
             max_inflight=max_inflight, host=host, port=port,
-            day=day, generation=gen, seed=seed)
+            day=day, generation=gen, seed=seed,
+            shard=self.shard, n_shards=self.n_shards,
+            ckpt_root=ckpt_root, hot_keys=hot_keys)
         self.port = self.replica.addr[1]
         self._arm_watch()
         self._watch = threading.Thread(target=self._run,
@@ -996,7 +1004,11 @@ class ServingReplicaSupervisor:
         return self.xbox_path, "", 1
 
     def _arm_watch(self) -> None:
-        if self.manifest_root and self.watch_s > 0:
+        # ckpt delta-streaming trumps day-granularity manifest polling:
+        # a replica fed from a TrainCheckpoint gets pass-level freshness
+        if self.ckpt_root:
+            self.replica.watch_ckpt(self.ckpt_root)
+        elif self.manifest_root and self.watch_s > 0:
             self.replica.watch_manifest(self.manifest_root, self.watch_s)
 
     def _restart(self) -> bool:
@@ -1017,7 +1029,9 @@ class ServingReplicaSupervisor:
                     config=self.config, xbox_path=path,
                     tenants=self.tenants, max_inflight=self.max_inflight,
                     host=self.host, port=self.port, day=day,
-                    generation=gen, seed=self.seed, dedup_state=dedup)
+                    generation=gen, seed=self.seed, dedup_state=dedup,
+                    shard=self.shard, n_shards=self.n_shards,
+                    ckpt_root=self.ckpt_root, hot_keys=self.hot_keys)
                 break
             except OSError:
                 attempt += 1
@@ -1053,12 +1067,23 @@ def serve_fleet(args) -> int:
     """--serve N: run N supervised serving replicas in this process and
     block until interrupted.  Prints the replica addresses (one per
     line, ``host:port``) so a router — ``ServingRouter([...])`` or an
-    external LB — can be pointed at the fleet."""
+    external LB — can be pointed at the fleet.
+
+    With ``--serve_shards S`` the N replicas split into S ServerMap
+    shard groups (replica i serves shard i % S) and the router runs in
+    ``shard_groups`` mode: per-shard fan, p2c hot-key routing, group
+    failover.  ``--serve_ckpt`` feeds the fleet pass-delta freshness
+    from a TrainCheckpoint instead of day-granularity xbox manifests."""
     from paddlebox_tpu.config import EmbeddingTableConfig
     from paddlebox_tpu.ps.serving import ServingRouter
     tenants = [t.strip() for t in (args.serve_tenants or "default"
                                    ).split(",") if t.strip()]
     config = EmbeddingTableConfig(embedding_dim=args.serve_mf_dim)
+    n_shards = max(1, int(getattr(args, "serve_shards", 1) or 1))
+    if n_shards > args.serve:
+        raise SystemExit(f"--serve_shards {n_shards} needs at least that "
+                         f"many replicas (--serve {args.serve})")
+    ckpt_root = getattr(args, "serve_ckpt", "") or None
     sups = [ServingReplicaSupervisor(
         config=config,
         xbox_path=args.serve_xbox or None,
@@ -1067,12 +1092,21 @@ def serve_fleet(args) -> int:
         max_inflight=args.serve_max_inflight,
         watch_s=args.serve_watch_s,
         seed=args.serve_seed,
+        shard=i % n_shards, n_shards=n_shards,
+        ckpt_root=ckpt_root,
         max_restarts=args.max_restarts or 8)
-        for _ in range(args.serve)]
+        for i in range(args.serve)]
     for s in sups:
         print(f"[serve] replica {s.addr[0]}:{s.addr[1]} "
+              f"shard={s.shard}/{n_shards} "
               f"tenants={','.join(tenants)}", file=sys.stderr)
-    router = ServingRouter([s.addr for s in sups], tenant=tenants[0])
+    if n_shards > 1:
+        groups = [[s.addr for s in sups if s.shard == k]
+                  for k in range(n_shards)]
+        router = ServingRouter(shard_groups=groups, tenant=tenants[0])
+        router.refresh_hot_keys()
+    else:
+        router = ServingRouter([s.addr for s in sups], tenant=tenants[0])
     try:
         while True:
             time.sleep(5.0)
@@ -1236,7 +1270,8 @@ def main():
     ap.add_argument("--serve", type=int, default=0,
                     help="run N supervised read-only serving replicas "
                          "(ps/serving.py) instead of training workers; "
-                         "needs --serve_xbox or --serve_manifest")
+                         "needs --serve_xbox, --serve_manifest or "
+                         "--serve_ckpt")
     ap.add_argument("--serve_xbox", default="",
                     help="xbox dump to serve (pinned; no hot-swap unless "
                          "--serve_manifest is also given)")
@@ -1260,6 +1295,20 @@ def main():
     ap.add_argument("--serve_seed", type=int, default=0,
                     help="default-row seed — must match the trainer for "
                          "bit-identical miss rows")
+    ap.add_argument("--serve_shards", type=int, default=1,
+                    help="split the fleet into S ServerMap shard groups "
+                         "(replica i serves shard i %% S); the router "
+                         "fans per shard and merges in key order")
+    ap.add_argument("--serve_ckpt", default="",
+                    help="TrainCheckpoint root to stream: replicas load "
+                         "the manifest head's base+delta chain and hot-"
+                         "patch each new save_pass generation "
+                         "(pass-granularity freshness vs day-granularity "
+                         "--serve_manifest)")
+    ap.add_argument("--serve_hot_keys", type=int, default=None,
+                    help="top-K heat-sketch keys replicated into every "
+                         "shard group for p2c routing (0 = off) "
+                         "(FLAGS_serving_hot_keys)")
     ap.add_argument("script", nargs="?", default="")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
@@ -1338,8 +1387,12 @@ def main():
             # pboxlint: disable-next=PB203 -- env export to spawned workers
             os.environ["FLAGS_serve_max_inflight"] = str(
                 args.serve_max_inflight)
-        if not (args.serve_xbox or args.serve_manifest):
-            ap.error("--serve needs --serve_xbox or --serve_manifest")
+        if args.serve_hot_keys is not None:
+            # pboxlint: disable-next=PB203 -- env export to spawned workers
+            os.environ["FLAGS_serving_hot_keys"] = str(args.serve_hot_keys)
+        if not (args.serve_xbox or args.serve_manifest or args.serve_ckpt):
+            ap.error("--serve needs --serve_xbox, --serve_manifest or "
+                     "--serve_ckpt")
         sys.exit(serve_fleet(args))
     ps_fleet = None
     if args.ps_servers:

@@ -169,6 +169,38 @@ class HeatMap:
                     self._imbalance_latched:
                 self._imbalance_latched = False
 
+    def hot_keys(self, k: int, site_prefix: str = "serve.") -> np.ndarray:
+        """Top-``k`` hot keys merged across the ``site_prefix`` sketches
+        (the serving tenants by default) — the measured replication set
+        for the serving tier's hot-key planes (ps/serving.py).  Counts of
+        the same key across tenants sum; ties break toward the smaller
+        key so the set is deterministic for a given sketch state.
+        Returns a SORTED uint64 array (at most ``k`` keys; empty when no
+        matching site has traffic yet).  Pure-array aggregation — the
+        candidate pool is bounded by k × matching sites, never the key
+        space."""
+        if k <= 0:
+            return np.zeros(0, np.uint64)
+        cand_keys: List[int] = []
+        cand_counts: List[float] = []
+        with self._lock:
+            for name, s in self._sites.items():
+                if not name.startswith(site_prefix):
+                    continue
+                for key, count, _err in s.tk.top(k):
+                    cand_keys.append(int(key))
+                    cand_counts.append(float(count))
+        if not cand_keys:
+            return np.zeros(0, np.uint64)
+        keys = np.asarray(cand_keys, np.uint64)
+        counts = np.asarray(cand_counts, np.float64)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        sums = np.zeros(len(uniq), np.float64)
+        np.add.at(sums, inv, counts)
+        # stable sort on -count ties toward ascending key (uniq is sorted)
+        order = np.argsort(-sums, kind="stable")[:k]
+        return np.sort(uniq[order])
+
     def observe_cache(self, hits: int, misses: int) -> None:
         """Device row cache admission outcome for one pass build:
         hot-coverage = share of pulled rows served resident."""
@@ -319,3 +351,12 @@ def maybe_enable_from_flags() -> Optional[HeatMap]:
 def summary() -> Optional[Dict[str, float]]:
     """Health-verb helper: compact heat dict, or None when heat is off."""
     return ACTIVE.summary() if ACTIVE is not None else None
+
+
+def serving_hot_keys(k: int) -> np.ndarray:
+    """The serving tier's measured hot-key set: top-``k`` keys across the
+    ``serve.*`` sketch sites, or empty when heat is off / cold.  Sorted
+    uint64 — directly usable as a replication set (ps/serving.py)."""
+    if ACTIVE is None or k <= 0:
+        return np.zeros(0, np.uint64)
+    return ACTIVE.hot_keys(k)

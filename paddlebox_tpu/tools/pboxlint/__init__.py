@@ -19,7 +19,8 @@ interpreter, so this package supplies the equivalent as lint passes over
          + device-cache mutation scope, PB503
            (tools/pboxlint/device_cache.py)
   PB6xx  lock-order graph       (tools/pboxlint/lockgraph.py)
-  PB7xx  serving read path      (tools/pboxlint/serving_path.py)
+  PB7xx  serving read path + frozen-plane immutability, PB702
+                                (tools/pboxlint/serving_path.py)
   PB8xx  cluster commit safety  (tools/pboxlint/cluster_commit.py)
   PB9xx  guarded-by inference / data races
                                 (tools/pboxlint/raceguard.py)

@@ -67,7 +67,8 @@ KNOWN_KINDS = frozenset({
     "resume_begin", "resume_ok", "supervisor_give_up",
     # serving tier
     "serving_load", "serving_swap", "serving_resurrect",
-    "serving_failover",
+    "serving_failover", "serving_delta_flip", "manifest_retry",
+    "manifest_giveup",
     # diagnostics
     "fault_injected", "lock_cycle", "race_suspect", "pool_saturated",
     "postmortem_written", "slo_breach", "slo_clear",

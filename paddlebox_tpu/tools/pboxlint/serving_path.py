@@ -36,6 +36,29 @@ offense is in serving code itself, else at the serving-side call site
 whose chain reaches the offense (the chain is spelled out in the
 message — the fix is almost always "don't call that from the read
 path").
+
+PB702 — frozen-plane immutability (the delta-patch contract).
+
+The streamed-freshness design (FrozenHostTable.patched) only stays
+zero-failed-requests because a published plane set is NEVER written:
+readers enter a generation lock-free precisely because its ``_keys`` /
+``_soa`` arrays cannot change under them, and a delta patch builds a NEW
+object copy-on-write before the one-reference flip.  An in-place "quick
+patch" (``tab._soa[f][pos] = rows`` — the obvious shortcut) would be a
+data race against every in-flight reader and break bit-identity between
+a patched replica and a from-scratch chain load, so:
+
+  PB702  any assignment (plain, augmented, or through subscripts) whose
+         target resolves to a ``._keys`` / ``._soa`` attribute outside
+         ``__init__`` in a serving module is a finding — the
+         copy-on-write patch builder (``FrozenHostTable.patched`` /
+         ``restrict``) is the sanctioned mutation path; construction
+         (``__init__``) is the only place the planes may be assigned.
+
+Purely syntactic (no call graph): the planes are named consistently and
+only serving modules hold FrozenHostTables, so an attribute-name match
+scoped to serving files has no false-positive surface worth the
+interprocedural cost.
 """
 
 from __future__ import annotations
@@ -174,6 +197,58 @@ def _analyze(lg: "lockgraph.LockAnalysis") -> List[Finding]:
     return findings
 
 
+# -- PB702: frozen-plane immutability (syntactic) ---------------------------
+_PLANES = frozenset({"_soa", "_keys"})
+
+
+def _plane_write_attrs(stmt) -> List[ast.Attribute]:
+    """Attribute nodes among ``stmt``'s assignment targets that resolve
+    (through any number of subscript layers) to a frozen plane."""
+    if isinstance(stmt, ast.Assign):
+        tgts = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        tgts = [stmt.target]
+    else:
+        return []
+    out: List[ast.Attribute] = []
+    for t in tgts:
+        elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+        for el in elts:
+            cur = el
+            while isinstance(cur, ast.Subscript):
+                cur = cur.value
+            if isinstance(cur, ast.Attribute) and cur.attr in _PLANES:
+                out.append(cur)
+    return out
+
+
+def _pb702(mod: Module) -> List[Finding]:
+    if mod.basename != "serving.py":
+        return []
+    findings: List[Finding] = []
+
+    def walk(node, in_init: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            inner = in_init
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner = child.name == "__init__"
+            if not inner:
+                for att in _plane_write_attrs(child):
+                    findings.append(Finding(
+                        mod.path, child.lineno, "PB702",
+                        f"write to frozen plane .{att.attr} outside "
+                        f"__init__ — published FrozenHostTable planes "
+                        f"are immutable (lock-free readers + patched-"
+                        f"vs-reload bit-identity depend on it); build "
+                        f"a new object via the copy-on-write patch "
+                        f"builder (FrozenHostTable.patched/restrict) "
+                        f"and publish it with the generation flip"))
+            walk(child, inner)
+
+    walk(mod.tree, False)
+    return findings
+
+
 def check(mod: Module, ctx: PackageContext) -> List[Finding]:
     cache = getattr(ctx, "_pb701", None)
     if cache is None:
@@ -183,4 +258,4 @@ def check(mod: Module, ctx: PackageContext) -> List[Finding]:
             ctx._lockgraph = lg
         cache = _analyze(lg)
         ctx._pb701 = cache
-    return [f for f in cache if f.path == mod.path]
+    return [f for f in cache if f.path == mod.path] + _pb702(mod)

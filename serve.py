@@ -15,12 +15,20 @@ Usage:
     python serve.py --xbox /dumps/xbox_base_20260805            # pinned
     python serve.py --manifest /dumps --watch_s 2 \
         --tenants ads,feed --max_inflight 128 --obs_port 9200   # fleet
+    python serve.py --ckpt /ckpt --shard 2 --n_shards 4         # sharded
 
 Multiple replicas: run this once per port (each loads the dump
 independently and answers bit-identically) and point a
 ``ServingRouter([(host, port), ...])`` at the set — or use
 ``python -m paddlebox_tpu.launch --serve N ...`` to supervise an
 in-process fleet with restart-in-place.
+
+Sharded fleets: give every process the SAME ``--n_shards`` and a
+distinct ``--shard``, then point a ``ServingRouter(shard_groups=[
+[(h, p), ...], ...])`` (group k = shard k's replicas) at the set.
+``--ckpt`` streams pass-delta freshness from a TrainCheckpoint root
+instead of day-granularity xbox manifests: each published ``save_pass``
+generation is hot-patched into the live planes copy-on-write.
 """
 
 from __future__ import annotations
@@ -38,6 +46,11 @@ def parse_args(argv=None):
     src.add_argument("--manifest", default="",
                      help="directory holding XBOX_MANIFEST.json; serves "
                           "the manifest's current dump")
+    src.add_argument("--ckpt", default="",
+                     help="TrainCheckpoint root to stream: loads the "
+                          "manifest head's base+delta chain and hot-"
+                          "patches each new save_pass generation "
+                          "(pass-granularity freshness)")
     ap.add_argument("--watch_s", type=float, default=0.0,
                     help="poll the manifest every N seconds and hot-swap "
                          "on a generation advance (0 = never; swap verb "
@@ -60,6 +73,19 @@ def parse_args(argv=None):
     ap.add_argument("--seed", type=int, default=0,
                     help="default-row seed — must match the trainer for "
                          "bit-identical miss rows")
+    ap.add_argument("--shard", type=int, default=0,
+                    help="ServerMap shard this replica owns (with "
+                         "--n_shards > 1 it keeps only its key range "
+                         "plus the replicated hot set)")
+    ap.add_argument("--n_shards", type=int, default=1,
+                    help="total ServerMap shards in the fleet — must "
+                         "match every other replica AND the router")
+    ap.add_argument("--hot_keys", type=int, default=None,
+                    help="top-K heat-sketch keys replicated into every "
+                         "shard (0 = off) (FLAGS_serving_hot_keys)")
+    ap.add_argument("--patch_poll_s", type=float, default=None,
+                    help="--ckpt manifest poll cadence "
+                         "(FLAGS_serving_patch_poll_s)")
     ap.add_argument("--obs_port", type=int, default=0,
                     help="/statz + /timelinez exporter port (0 = off)")
     ap.add_argument("--timeline_s", type=float, default=1.0,
@@ -82,6 +108,10 @@ def main(argv=None) -> int:
         fl["serve_max_inflight"] = args.max_inflight
     if args.obs_port:
         fl["obs_port"] = args.obs_port
+    if args.hot_keys is not None:
+        fl["serving_hot_keys"] = args.hot_keys
+    if args.patch_poll_s is not None:
+        fl["serving_patch_poll_s"] = args.patch_poll_s
     flags.set_flags(fl)
 
     path, day, gen = args.xbox, args.day, args.generation
@@ -101,8 +131,12 @@ def main(argv=None) -> int:
     rep = ServingReplica(config=config, xbox_path=path, tenants=tenants,
                          max_inflight=args.max_inflight, host=args.host,
                          port=args.port, day=day, generation=gen,
-                         seed=args.seed)
-    if args.manifest and args.watch_s > 0:
+                         seed=args.seed, shard=args.shard,
+                         n_shards=args.n_shards,
+                         ckpt_root=args.ckpt or None)
+    if args.ckpt:
+        rep.watch_ckpt()
+    elif args.manifest and args.watch_s > 0:
         rep.watch_manifest(args.manifest, args.watch_s)
 
     obs_server.maybe_start_from_flags()
@@ -111,8 +145,11 @@ def main(argv=None) -> int:
         rules = timeline.default_rules() + timeline.serving_rules(tenants)
         sampler = timeline.start(interval_s=args.timeline_s, rules=rules)
 
-    print(f"serve: replica {rep.addr[0]}:{rep.addr[1]} day={day!r} "
-          f"generation={gen} tenants={','.join(tenants)} dump={path}",
+    src = f"ckpt={args.ckpt}" if args.ckpt else f"dump={path}"
+    print(f"serve: replica {rep.addr[0]}:{rep.addr[1]} "
+          f"shard={args.shard}/{max(1, args.n_shards)} "
+          f"generation={rep._gen.generation} "
+          f"tenants={','.join(tenants)} {src}",
           file=sys.stderr, flush=True)
     try:
         while not rep._dead:

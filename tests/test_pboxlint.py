@@ -1333,6 +1333,85 @@ def test_pb701_non_serving_module_out_of_scope():
     assert "PB701" not in serving_codes(src, path="ps/other.py")
 
 
+# -- PB702: frozen-plane immutability -----------------------------------------
+
+def test_pb702_inplace_patch_of_published_planes():
+    """The pre-fix shortcut the rule exists for: an in-place 'hot patch'
+    of a live FrozenHostTable's SoA — a data race against every in-flight
+    lock-free reader, and it forks the replica from a from-scratch chain
+    load.  The sanctioned path is the copy-on-write patch builder."""
+    src = """
+    import numpy as np
+
+    class Rep:
+        def apply_delta(self, tab, keys, rows):
+            pos = np.searchsorted(tab._keys, keys)
+            for f in rows:
+                tab._soa[f][pos] = rows[f]      # in-place hot patch
+    """
+    assert "PB702" in serving_codes(src)
+
+
+def test_pb702_whole_plane_reassignment():
+    src = """
+    class Rep:
+        def rebase(self, tab, keys, soa):
+            tab._keys = keys
+            tab._soa = soa
+    """
+    assert serving_codes(src).count("PB702") == 2
+
+
+def test_pb702_augmented_write():
+    src = """
+    class Tab:
+        def decay(self, rate):
+            self._soa["show"] *= rate
+    """
+    assert "PB702" in serving_codes(src)
+
+
+def test_pb702_init_construction_allowed():
+    """__init__ is the one sanctioned assignment site — construction of
+    a NEW object (what patched()/restrict() do) is the COW path itself."""
+    src = """
+    import numpy as np
+
+    class Tab:
+        def __init__(self, keys, soa):
+            order = np.argsort(keys, kind="stable")
+            self._keys = keys[order]
+            self._soa = {f: a[order] for f, a in soa.items()}
+    """
+    assert serving_codes(src) == []
+
+
+def test_pb702_reads_and_locals_silent():
+    """Reads of the planes and writes to LOCAL gather outputs (the miss
+    path's out[f][found] = ...) are not plane writes."""
+    src = """
+    import numpy as np
+
+    class Tab:
+        def lookup_rows(self, keys):
+            pos = np.searchsorted(self._keys, keys)
+            out = {f: np.zeros(len(keys)) for f in self._soa}
+            for f, arr in self._soa.items():
+                out[f][pos] = arr[pos]
+            return out
+    """
+    assert serving_codes(src) == []
+
+
+def test_pb702_non_serving_module_out_of_scope():
+    src = """
+    class Tab:
+        def rebase(self, keys):
+            self._keys = keys
+    """
+    assert "PB702" not in serving_codes(src, path="ps/host_table.py")
+
+
 # -- PB8xx PS-cluster commit discipline ---------------------------------------
 
 def test_pb801_hand_built_lifecycle_frame():
